@@ -1,0 +1,40 @@
+"""vilbert_multitask_tpu — a TPU-native (JAX/XLA/Flax/Pallas/pjit) framework with the
+capabilities of the Cloud-CV/vilbert-multi-task demo stack.
+
+The reference system (see /root/reference, surveyed in SURVEY.md) is a Django web
+demo plus a RabbitMQ-driven single-GPU PyTorch inference worker serving 8
+vision-and-language task types from one 270M-parameter "12-in-1" ViLBERT
+checkpoint. This package re-designs every layer TPU-first:
+
+- ``models/``     two-stream ViLBERT trunk + 9 task heads as Flax modules
+                  (reference capability: the external ``vilbert`` package,
+                  imported at reference worker.py:44-46).
+- ``ops/``        attention primitives and the Pallas co-attention kernel
+                  (reference capability: CUDA kernels inside torch).
+- ``parallel/``   device mesh, NamedSharding partition rules, collectives
+                  (reference has none — worker.py:481 pins distributed=False;
+                  here parallelism is first-class).
+- ``text/``       pure-host WordPiece tokenizer (reference: pytorch_transformers
+                  BertTokenizer, worker.py:42,537-539).
+- ``features/``   precomputed region-feature pipeline + vectorized NMS
+                  (reference: maskrcnn_benchmark C++/CUDA, worker.py:50-54).
+- ``engine/``     jit-compiled shape-bucketed inference runner + per-task decode
+                  (reference: worker.py:248-458).
+- ``checkpoint/`` Orbax checkpointing + torch-state-dict converter
+                  (reference: from_pretrained at worker.py:530-532).
+- ``serve/``      durable job queue, HTTP API, websocket push, result store
+                  (reference: demo/ Django app + pika, SURVEY.md L3-L6).
+- ``native/``     C++ runtime pieces (NMS, feature store IO) built with g++,
+                  bound via ctypes (reference: maskrcnn_benchmark native ops).
+"""
+
+__version__ = "0.1.0"
+
+from vilbert_multitask_tpu.config import (  # noqa: F401
+    ViLBertConfig,
+    TaskSpec,
+    TASK_REGISTRY,
+    EngineConfig,
+    ServingConfig,
+    FrameworkConfig,
+)

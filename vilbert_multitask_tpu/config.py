@@ -1,0 +1,269 @@
+"""One typed config tree for the whole framework.
+
+The reference spreads configuration over five uncoordinated mechanisms
+(SURVEY.md §5): hardcoded SimpleNamespace blobs (reference worker.py:67-76,
+470-493), a BertConfig JSON plus post-hoc attribute pokes (worker.py:495-522),
+a YAML task registry (worker.py:496-503), a YACS detector config (worker.py:79),
+and Django settings. This module collapses all five into frozen dataclasses:
+
+- :class:`ViLBertConfig`   — the model (mirrors config/bert_base_6layer_6conect.json
+  plus the overrides applied at worker.py:509-522).
+- :class:`TaskSpec` / :data:`TASK_REGISTRY` — the 8 served task types
+  (UI dropdown result.html:318-336; dispatch worker.py:250-263).
+- :class:`EngineConfig`    — inference runtime (shape buckets, dtypes, mesh).
+- :class:`ServingConfig`   — queue/HTTP/websocket/DB tier.
+- :class:`FrameworkConfig` — the root aggregate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ViLBertConfig:
+    """Two-stream ViLBERT architecture knobs.
+
+    Field names follow the reference config JSON (``bert_base_6layer_6conect.json``,
+    loaded at reference worker.py:472,495) so checkpoints and configs translate
+    1:1. Defaults are the values the reference demo actually serves with,
+    including the runtime overrides at worker.py:509-523 (``v_target_size=1601``,
+    ``predict_feature=False``, ``task_specific_tokens=True``,
+    ``visualization=True``, ``num_labels=3129``).
+    """
+
+    # --- text stream (BERT-base) ---
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+    # --- visual stream ---
+    v_feature_size: int = 2048
+    v_target_size: int = 1601
+    v_hidden_size: int = 1024
+    v_num_hidden_layers: int = 6
+    v_num_attention_heads: int = 8
+    v_intermediate_size: int = 1024
+    v_hidden_act: str = "gelu"
+    v_hidden_dropout_prob: float = 0.1
+    v_attention_probs_dropout_prob: float = 0.1
+    v_initializer_range: float = 0.02
+
+    # --- co-attention bridge ---
+    bi_hidden_size: int = 1024
+    bi_num_attention_heads: int = 8
+    bi_intermediate_size: int = 1024
+    # Text layer i in t_biattention_id co-attends with visual layer j at the
+    # same position in v_biattention_id ("6 connect" in the config name).
+    v_biattention_id: Sequence[int] = (0, 1, 2, 3, 4, 5)
+    t_biattention_id: Sequence[int] = (6, 7, 8, 9, 10, 11)
+    fusion_method: str = "mul"  # pooled_t ∘ pooled_v fusion for vil_* heads
+
+    # --- behavior flags (reference worker.py:509-523) ---
+    predict_feature: bool = False
+    task_specific_tokens: bool = True
+    num_task_tokens: int = 20  # task-token embedding table size
+    dynamic_attention: bool = False
+    visualization: bool = True  # return per-layer attention maps (10th output)
+
+    # --- heads ---
+    num_labels: int = 3129  # VQA answer space (worker.py:523)
+    gqa_num_labels: int = 1533  # GQA answer space (12-in-1 head width)
+
+    def __post_init__(self):
+        if len(self.v_biattention_id) != len(self.t_biattention_id):
+            raise ValueError("v_biattention_id and t_biattention_id must pair up")
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError("hidden_size must divide num_attention_heads")
+        if self.v_hidden_size % self.v_num_attention_heads:
+            raise ValueError("v_hidden_size must divide v_num_attention_heads")
+        if self.bi_hidden_size % self.bi_num_attention_heads:
+            raise ValueError("bi_hidden_size must divide bi_num_attention_heads")
+
+    @property
+    def num_connection_layers(self) -> int:
+        return len(self.v_biattention_id)
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "ViLBertConfig":
+        """Load a reference-format config JSON (ignores unknown keys)."""
+        with open(path) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["v_biattention_id"] = list(self.v_biattention_id)
+        d["t_biattention_id"] = list(self.t_biattention_id)
+        return json.dumps(d, indent=2, sort_keys=True)
+
+    def tiny(self, **overrides) -> "ViLBertConfig":
+        """A scaled-down config for CPU tests (same topology, small dims)."""
+        small = dict(
+            vocab_size=512,
+            hidden_size=48,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            v_feature_size=32,
+            v_target_size=11,
+            v_hidden_size=32,
+            v_num_hidden_layers=2,
+            v_num_attention_heads=2,
+            v_intermediate_size=32,
+            bi_hidden_size=32,
+            bi_num_attention_heads=2,
+            bi_intermediate_size=32,
+            v_biattention_id=(0, 1),
+            t_biattention_id=(2, 3),
+            num_labels=17,
+            gqa_num_labels=13,
+        )
+        small.update(overrides)
+        return dataclasses.replace(self, **small)
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskSpec:
+    """One served task type (reference: UI dropdown result.html:318-336 +
+    worker dispatch worker.py:250-263,295-386)."""
+
+    task_id: int
+    name: str
+    head: str  # which model output decodes this task
+    decode: str  # decode family: "labels" | "binary" | "trinary" | "ranking" | "grounding"
+    min_images: int
+    max_images: int
+    top_k: int  # how many ranked answers the demo shows
+    label_map: str | None = None  # key into the label-map store, if any
+    description: str = ""
+    placeholder: str = ""
+
+    def validate_num_images(self, n: int) -> None:
+        """Image-count gating, matching the asserts at worker.py:256-263."""
+        if not (self.min_images <= n <= self.max_images):
+            raise ValueError(
+                f"task {self.task_id} ({self.name}) requires "
+                f"{self.min_images}..{self.max_images} images, got {n}"
+            )
+
+
+# The 8 served task types. task_id values are the reference's wire protocol —
+# they appear in queue messages (demo/sender.py:26-31) and the UI (result.html:318-336).
+TASK_REGISTRY: Mapping[int, TaskSpec] = {
+    t.task_id: t
+    for t in [
+        TaskSpec(1, "VQA", head="vil_prediction", decode="labels", min_images=1,
+                 max_images=1, top_k=3, label_map="vqa",
+                 description="Visual question answering (VQAv2)",
+                 placeholder="e.g. What is the man holding?"),
+        TaskSpec(2, "VQA-variant", head="vil_prediction", decode="labels", min_images=1,
+                 max_images=1, top_k=3, label_map="vqa",
+                 description="Alias of VQA; decodable but absent from the reference UI "
+                             "(worker.py:295,564 vs result.html:318-336)"),
+        TaskSpec(15, "GQA", head="vil_prediction_gqa", decode="labels", min_images=1,
+                 max_images=1, top_k=3, label_map="gqa",
+                 description="Spatial-reasoning QA (GQA)",
+                 placeholder="e.g. Is the bowl to the right of the mug?"),
+        TaskSpec(4, "Visual7W", head="vision_logit", decode="grounding", min_images=1,
+                 max_images=1, top_k=3,
+                 description="Pointing QA — answer is a box",
+                 placeholder="e.g. Which object can you eat?"),
+        TaskSpec(11, "RefCOCO", head="vision_logit", decode="grounding", min_images=1,
+                 max_images=1, top_k=3,
+                 description="Referring-expression grounding",
+                 placeholder="e.g. the woman in the red coat"),
+        TaskSpec(16, "GuessWhat", head="vision_logit", decode="grounding", min_images=1,
+                 max_images=1, top_k=3,
+                 description="Referring dialog grounding (Q:..? A:.. format)",
+                 placeholder="e.g. Q: is it a person? A: no Q: is it red? A: yes"),
+        TaskSpec(13, "SNLI-VE", head="vil_tri_prediction", decode="trinary", min_images=1,
+                 max_images=1, top_k=3,
+                 description="Visual entailment: contradiction/neutral/entailment",
+                 placeholder="e.g. Two dogs are playing in the snow."),
+        TaskSpec(12, "NLVR2", head="vil_binary_prediction", decode="binary", min_images=2,
+                 max_images=2, top_k=2,
+                 description="Does the caption describe the image pair? True/False",
+                 placeholder="e.g. Both images contain exactly two wolves."),
+        TaskSpec(7, "Retrieval", head="vil_logit", decode="ranking", min_images=2,
+                 max_images=10, top_k=0,  # top_k=#images, resolved at decode time
+                 description="Caption-based image retrieval over the uploaded set",
+                 placeholder="e.g. A man riding a horse on the beach."),
+    ]
+}
+
+# Decode label maps that are fixed (not loaded from disk).
+NLVR2_LABELS = ("False", "True")  # worker.py:327
+SNLI_VE_LABELS = ("contradiction (false)", "neutral", "entailment (true)")  # worker.py:342
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Inference-runtime knobs (replaces the SimpleNamespace blob at
+    reference worker.py:470-493 and the implicit shapes in custom_prediction)."""
+
+    max_text_len: int = 37  # wordpiece tokens incl. [CLS]/[SEP] (worker.py:408)
+    max_regions: int = 101  # 100 detector boxes + 1 global feature (worker.py:71,433)
+    num_features: int = 100  # detector boxes kept per image (worker.py:71)
+    # Static shape buckets for the image axis: NLVR2 needs 2, retrieval 2..10
+    # (worker.py:256-284). Each bucket compiles once.
+    image_buckets: Sequence[int] = (1, 2, 4, 8, 10)
+    compute_dtype: str = "bfloat16"  # MXU-native compute precision
+    param_dtype: str = "float32"
+    use_pallas_coattention: bool = False  # flip on TPU once kernel validated
+    donate_buffers: bool = True
+
+    def bucket_for(self, n_images: int) -> int:
+        for b in self.image_buckets:
+            if n_images <= b:
+                return b
+        raise ValueError(f"no shape bucket holds {n_images} images")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout. The reference has no intra-model parallelism
+    (SURVEY.md §2.3); here DP×TP over ICI is first-class."""
+
+    dp: int = -1  # -1: all remaining devices
+    tp: int = 1
+    axis_names: Sequence[str] = ("dp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Web/queue tier (replaces Django settings + demo/constants.py +
+    sender/worker pika constants)."""
+
+    queue_name: str = "vilbert_multitask_queue"  # wire-compatible (sender.py:18)
+    queue_db_path: str = "serve_state/queue.sqlite3"
+    results_db_path: str = "serve_state/results.sqlite3"
+    media_root: str = "media"
+    refer_expr_dir: str = "refer_expressions_task"  # worker.py:600
+    http_host: str = "127.0.0.1"
+    http_port: int = 8400
+    ws_port: int = 8401
+    max_upload_images: int = 10
+    max_delivery_attempts: int = 3  # poison-message bound (fixes worker.py:650-655)
+    lowercase_questions: bool = True  # reference lowercases server-side (views.py:27)
+
+
+@dataclasses.dataclass(frozen=True)
+class FrameworkConfig:
+    model: ViLBertConfig = dataclasses.field(default_factory=ViLBertConfig)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)

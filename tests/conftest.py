@@ -1,17 +1,23 @@
 """Test harness: force an 8-device virtual CPU mesh so sharding tests run
-anywhere (the standard JAX fake-backend trick; see SURVEY.md §4)."""
+anywhere (the standard JAX fake-backend trick; see SURVEY.md §4).
+
+Note: this environment's sitecustomize registers a TPU PJRT plugin in every
+Python process; selecting it costs a ~2-minute remote handshake. Tests must
+never touch it, so we pin the platform to CPU *before any backend init* —
+``jax.config.update`` works post-import as long as ``jax.devices()`` hasn't
+been called yet, and XLA_FLAGS is read at first backend init.
+"""
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-
-import jax  # noqa: E402
 
 jax.config.update("jax_threefry_partitionable", True)
 

@@ -1,0 +1,107 @@
+"""Shape/contract tests for the two-stream model against the reference
+10-tuple contract (reference worker.py:287-289)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks
+
+
+def make_inputs(cfg, batch=2, n_text=9, n_regions=7, seed=0):
+    rng = np.random.RandomState(seed)
+    return dict(
+        input_ids=jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, n_text))),
+        features=jnp.asarray(
+            rng.randn(batch, n_regions, cfg.v_feature_size), jnp.float32
+        ),
+        spatials=jnp.asarray(rng.rand(batch, n_regions, 5), jnp.float32),
+        segment_ids=jnp.zeros((batch, n_text), jnp.int32),
+        input_mask=jnp.asarray(
+            (np.arange(n_text)[None, :] < rng.randint(3, n_text, (batch, 1))).astype(
+                np.int32
+            )
+        ),
+        image_mask=jnp.ones((batch, n_regions), jnp.int32),
+        task_ids=jnp.ones((batch, 1), jnp.int32),
+    )
+
+
+@pytest.fixture(scope="module")
+def model_and_params(tiny_config, rng):
+    model = ViLBertForVLTasks(tiny_config)
+    inputs = make_inputs(tiny_config)
+    params = model.init(rng, **inputs)
+    return model, params, inputs
+
+
+def test_output_shapes(tiny_config, model_and_params):
+    model, params, inputs = model_and_params
+    cfg = tiny_config
+    B, Nt = inputs["input_ids"].shape
+    Nv = inputs["features"].shape[1]
+    out = model.apply(params, **inputs, output_all_attention_masks=True)
+
+    assert out.vil_prediction.shape == (B, cfg.num_labels)
+    assert out.vil_prediction_gqa.shape == (B, cfg.gqa_num_labels)
+    assert out.vil_logit.shape == (B, 1)
+    assert out.vil_binary_prediction.shape == (B // 2, 2)
+    assert out.vil_tri_prediction.shape == (B, 3)
+    assert out.vision_prediction.shape == (B, Nv, cfg.v_target_size)
+    assert out.vision_logit.shape == (B, Nv, 1)
+    # task token extends the text sequence by one
+    assert out.linguisic_prediction.shape == (B, Nt + 1, cfg.vocab_size)
+    assert out.linguisic_logit.shape == (B, Nt + 1, 1)
+    # one (text→image, image→text) pair per connection layer
+    assert len(out.attn_data_list) == cfg.num_connection_layers
+    t2v, v2t = out.attn_data_list[0]
+    assert t2v.shape == (B, cfg.bi_num_attention_heads, Nt + 1, Nv)
+    assert v2t.shape == (B, cfg.bi_num_attention_heads, Nv, Nt + 1)
+    # 10-tuple ordering is stable
+    tup = out.to_tuple()
+    assert len(tup) == 10
+    assert tup[0] is out.vil_prediction and tup[-1] is out.attn_data_list
+
+
+def test_deterministic_and_finite(model_and_params):
+    model, params, inputs = model_and_params
+    out1 = model.apply(params, **inputs)
+    out2 = model.apply(params, **inputs)
+    np.testing.assert_array_equal(out1.vil_prediction, out2.vil_prediction)
+    for leaf in [out1.vil_prediction, out1.vision_logit, out1.linguisic_prediction]:
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_image_mask_penalty(model_and_params):
+    """Masked-out regions must be unselectable by the grounding decode."""
+    model, params, inputs = model_and_params
+    masked = dict(inputs)
+    image_mask = np.asarray(masked["image_mask"]).copy()
+    image_mask[:, -2:] = 0
+    masked["image_mask"] = jnp.asarray(image_mask)
+    out = model.apply(params, **masked)
+    logits = np.asarray(out.vision_logit)[..., 0]
+    assert (logits[:, -2:] < -9000).all()
+    assert (logits[:, :-2] > -9000).all()
+
+
+def test_odd_batch_skips_binary_head(tiny_config, rng):
+    model = ViLBertForVLTasks(tiny_config)
+    inputs = make_inputs(tiny_config, batch=3)
+    params = model.init(rng, **make_inputs(tiny_config, batch=2))
+    out = model.apply(params, **inputs)
+    assert out.vil_binary_prediction is None
+
+
+def test_dropout_rng_training_mode(tiny_config, rng):
+    model = ViLBertForVLTasks(tiny_config)
+    inputs = make_inputs(tiny_config)
+    params = model.init(rng, **inputs)
+    d1 = model.apply(
+        params, **inputs, deterministic=False, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    d2 = model.apply(
+        params, **inputs, deterministic=False, rngs={"dropout": jax.random.PRNGKey(2)}
+    )
+    assert not np.allclose(d1.vil_prediction, d2.vil_prediction)

@@ -1,0 +1,99 @@
+"""Input embeddings for both streams.
+
+Reference capability: BertEmbeddings / BertImageEmbeddings inside the external
+``vilbert`` package. Behavioral contract reproduced:
+
+- text = word + position + token-type embeddings, then (with
+  ``task_specific_tokens=True``, reference worker.py:485,516-517) the task
+  token embedding is inserted **after [CLS]**, extending the sequence by one;
+  LayerNorm + dropout applied after insertion.
+- image = linear(2048 fc6 feature) + linear(5-dim normalized box geometry),
+  summed, LayerNorm + dropout. The 5-dim spatial layout is built host-side
+  (features/pipeline.py, mirroring worker.py:436-444).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from vilbert_multitask_tpu.config import ViLBertConfig
+
+
+class TextEmbeddings(nn.Module):
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.word_embeddings = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="word_embeddings"
+        )
+        self.position_embeddings = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+            name="position_embeddings",
+        )
+        self.token_type_embeddings = nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+            name="token_type_embeddings",
+        )
+        if cfg.task_specific_tokens:
+            self.task_embeddings = nn.Embed(
+                cfg.num_task_tokens, cfg.hidden_size, dtype=self.dtype,
+                name="task_embeddings",
+            )
+        self.norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def __call__(self, input_ids, token_type_ids, task_ids=None, *, deterministic=True):
+        cfg = self.config
+        N = input_ids.shape[1]
+        positions = jnp.arange(N)[None, :]
+        x = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(positions)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        if cfg.task_specific_tokens:
+            if task_ids is None:
+                raise ValueError("task_specific_tokens=True requires task_ids")
+            task = self.task_embeddings(task_ids)  # (B, 1, H)
+            # Insert after [CLS]: [cls, task, rest...] → sequence length N+1.
+            x = jnp.concatenate([x[:, :1], task, x[:, 1:]], axis=1)
+        x = self.norm(x)
+        return self.dropout(x, deterministic=deterministic)
+
+    @property
+    def word_table(self) -> jnp.ndarray:
+        """The (vocab, hidden) embedding matrix, for the tied LM decoder."""
+        return self.word_embeddings.embedding
+
+    @staticmethod
+    def extend_mask_for_task_token(mask: jnp.ndarray) -> jnp.ndarray:
+        """Extend a (B, N) attention mask to (B, N+1) for the inserted task
+        token (always attended)."""
+        ones = jnp.ones_like(mask[:, :1])
+        return jnp.concatenate([mask[:, :1], ones, mask[:, 1:]], axis=1)
+
+
+class ImageEmbeddings(nn.Module):
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.image_embeddings = nn.Dense(
+            cfg.v_hidden_size, dtype=self.dtype, name="image_embeddings"
+        )
+        self.image_location_embeddings = nn.Dense(
+            cfg.v_hidden_size, dtype=self.dtype, name="image_location_embeddings"
+        )
+        self.norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype)
+        self.dropout = nn.Dropout(cfg.v_hidden_dropout_prob)
+
+    def __call__(self, features, spatials, *, deterministic=True):
+        """features: (B, Nv, v_feature_size); spatials: (B, Nv, 5)."""
+        feat = self.image_embeddings(features.astype(self.dtype))
+        loc = self.image_location_embeddings(spatials.astype(self.dtype))
+        x = self.norm(feat + loc)
+        return self.dropout(x, deterministic=deterministic)

@@ -1,0 +1,128 @@
+"""Two-stream encoder with interleaved co-attention.
+
+The schedule is derived statically from ``t_biattention_id`` / ``v_biattention_id``
+(config name ``bert_base_6layer_6conect``): with t ids (6..11) and v ids (0..5),
+
+    text 0..5 → co-attn 0 → text 6 + vis 0 → co-attn 1 → ... → co-attn 5
+    → vis 5 → text 11
+
+i.e. the first six text layers run before the visual stream starts, then each
+bridge interleaves one layer per stream, and each stream finishes its tail
+after the last bridge. The loop is plain Python over a static schedule — under
+``jit`` it traces once into a flat XLA graph (no dynamic control flow).
+
+Reference capability: BertEncoder in the external ``vilbert`` package
+(driven from worker.py:286-289); redesigned for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from vilbert_multitask_tpu.config import ViLBertConfig
+from vilbert_multitask_tpu.models.layers import ConnectionLayer, TransformerLayer
+
+
+class TwoStreamEncoder(nn.Module):
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.t_layers = [
+            TransformerLayer(
+                hidden_size=cfg.hidden_size,
+                num_heads=cfg.num_attention_heads,
+                intermediate_size=cfg.intermediate_size,
+                activation=cfg.hidden_act,
+                hidden_dropout=cfg.hidden_dropout_prob,
+                attention_dropout=cfg.attention_probs_dropout_prob,
+                layer_norm_eps=cfg.layer_norm_eps,
+                dtype=self.dtype,
+                name=f"t_layer_{i}",
+            )
+            for i in range(cfg.num_hidden_layers)
+        ]
+        self.v_layers = [
+            TransformerLayer(
+                hidden_size=cfg.v_hidden_size,
+                num_heads=cfg.v_num_attention_heads,
+                intermediate_size=cfg.v_intermediate_size,
+                activation=cfg.v_hidden_act,
+                hidden_dropout=cfg.v_hidden_dropout_prob,
+                attention_dropout=cfg.v_attention_probs_dropout_prob,
+                layer_norm_eps=cfg.layer_norm_eps,
+                dtype=self.dtype,
+                name=f"v_layer_{i}",
+            )
+            for i in range(cfg.v_num_hidden_layers)
+        ]
+        self.c_layers = [
+            ConnectionLayer(
+                hidden_size=cfg.hidden_size,
+                v_hidden_size=cfg.v_hidden_size,
+                bi_hidden_size=cfg.bi_hidden_size,
+                bi_num_heads=cfg.bi_num_attention_heads,
+                intermediate_size=cfg.intermediate_size,
+                v_intermediate_size=cfg.v_intermediate_size,
+                activation=cfg.hidden_act,
+                v_activation=cfg.v_hidden_act,
+                hidden_dropout=cfg.hidden_dropout_prob,
+                attention_dropout=cfg.attention_probs_dropout_prob,
+                layer_norm_eps=cfg.layer_norm_eps,
+                dtype=self.dtype,
+                name=f"c_layer_{i}",
+            )
+            for i in range(cfg.num_connection_layers)
+        ]
+
+    def __call__(
+        self,
+        t_hidden,
+        v_hidden,
+        t_mask_bias,
+        v_mask_bias,
+        *,
+        deterministic: bool = True,
+        collect_attention: bool = False,
+    ):
+        cfg = self.config
+        attn_maps: List[Tuple] = []
+
+        t_ptr = 0
+        v_ptr = 0
+        for c_idx, (v_stop, t_stop) in enumerate(
+            zip(cfg.v_biattention_id, cfg.t_biattention_id)
+        ):
+            while t_ptr < t_stop:
+                t_hidden, t_probs = self.t_layers[t_ptr](
+                    t_hidden, t_mask_bias, deterministic=deterministic
+                )
+                t_ptr += 1
+            while v_ptr < v_stop:
+                v_hidden, v_probs = self.v_layers[v_ptr](
+                    v_hidden, v_mask_bias, deterministic=deterministic
+                )
+                v_ptr += 1
+            v_hidden, t_hidden, co_probs = self.c_layers[c_idx](
+                v_hidden, v_mask_bias, t_hidden, t_mask_bias,
+                deterministic=deterministic,
+            )
+            if collect_attention:
+                attn_maps.append(co_probs)
+
+        while v_ptr < cfg.v_num_hidden_layers:
+            v_hidden, _ = self.v_layers[v_ptr](
+                v_hidden, v_mask_bias, deterministic=deterministic
+            )
+            v_ptr += 1
+        while t_ptr < cfg.num_hidden_layers:
+            t_hidden, _ = self.t_layers[t_ptr](
+                t_hidden, t_mask_bias, deterministic=deterministic
+            )
+            t_ptr += 1
+
+        return t_hidden, v_hidden, attn_maps

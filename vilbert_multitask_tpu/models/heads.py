@@ -1,0 +1,91 @@
+"""Poolers and the nine task heads.
+
+Output contract = the 10-tuple unpacked at reference worker.py:287-289:
+
+    vil_prediction, vil_prediction_gqa, vil_logit, vil_binary_prediction,
+    vil_tri_prediction, vision_prediction, vision_logit,
+    linguisic_prediction, linguisic_logit, attn_data_list
+
+Head topologies follow the 12-in-1 model family:
+- poolers take the first token of each stream through a Dense + ReLU into the
+  shared ``bi_hidden`` space (text CLS / visual global-feature token);
+- ``SimpleClassifier`` = Dense → GELU → LayerNorm → Dense;
+- vision/linguistic "prediction" heads are the masked-modeling heads
+  (transform + decoder; text decoder tied to the word-embedding table);
+- ``vision_logit`` / ``linguisic_logit`` are per-token linear grounding heads,
+  with the image-mask penalty folded in (tokens outside the mask get -10000).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from vilbert_multitask_tpu.config import ViLBertConfig
+from vilbert_multitask_tpu.models.layers import ACT
+
+
+class Pooler(nn.Module):
+    """First-token pooler into the bi_hidden space (ReLU, per ViLBERT)."""
+
+    out_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden):
+        x = nn.Dense(self.out_dim, dtype=self.dtype, name="dense")(hidden[:, 0])
+        return nn.relu(x)
+
+
+class SimpleClassifier(nn.Module):
+    """Dense → GELU → LayerNorm → Dense (12-in-1 classifier topology)."""
+
+    hidden_dim: int
+    out_dim: int
+    layer_norm_eps: float = 1e-12
+    activation: str = "gelu"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="dense1")(x)
+        h = ACT[self.activation](h)
+        h = nn.LayerNorm(epsilon=self.layer_norm_eps, dtype=self.dtype, name="norm")(h)
+        return nn.Dense(self.out_dim, dtype=self.dtype, name="dense2")(h)
+
+
+class TextPredictionHead(nn.Module):
+    """Masked-LM head: transform + tied decoder over the vocab."""
+
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, word_embedding_table):
+        cfg = self.config
+        h = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="transform_dense")(hidden)
+        h = ACT[cfg.hidden_act](h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         name="transform_norm")(h)
+        logits = jnp.einsum(
+            "bnh,vh->bnv", h, word_embedding_table.astype(self.dtype),
+            preferred_element_type=self.dtype,
+        )
+        bias = self.param("decoder_bias", nn.initializers.zeros, (cfg.vocab_size,))
+        return logits + bias.astype(self.dtype)
+
+
+class ImagePredictionHead(nn.Module):
+    """Masked-region head: transform + decoder onto v_target_size classes."""
+
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        h = nn.Dense(cfg.v_hidden_size, dtype=self.dtype, name="transform_dense")(hidden)
+        h = ACT[cfg.v_hidden_act](h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         name="transform_norm")(h)
+        return nn.Dense(cfg.v_target_size, dtype=self.dtype, name="decoder")(h)

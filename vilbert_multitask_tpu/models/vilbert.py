@@ -1,0 +1,212 @@
+"""The flagship model: two-stream ViLBERT trunk + 9 task heads.
+
+Reference capability: ``VILBertForVLTasks`` from the external ``vilbert``
+package — constructed at worker.py:530-536, called at worker.py:286-289 with
+
+    model(question, features, spatials, segment_ids, input_mask, image_mask,
+          co_attention_mask, task_tokens, output_all_attention_masks=True)
+
+returning the 10-tuple decoded at worker.py:295-386. This module reproduces
+that call contract (as a typed :class:`ViLBertOutput`) on a TPU-first stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+from flax import struct
+
+from vilbert_multitask_tpu.config import ViLBertConfig
+from vilbert_multitask_tpu.models.embeddings import ImageEmbeddings, TextEmbeddings
+from vilbert_multitask_tpu.models.encoder import TwoStreamEncoder
+from vilbert_multitask_tpu.models.heads import (
+    ImagePredictionHead,
+    Pooler,
+    SimpleClassifier,
+    TextPredictionHead,
+)
+from vilbert_multitask_tpu.ops.attention import mask_to_bias
+
+
+@struct.dataclass
+class ViLBertOutput:
+    """Typed view of the reference 10-tuple (worker.py:287-289).
+
+    A registered pytree (flax.struct) so it can cross ``jit``/``pjit``
+    boundaries and be sharded leaf-wise.
+    """
+
+    vil_prediction: jnp.ndarray  # (B, num_labels)        VQA
+    vil_prediction_gqa: jnp.ndarray  # (B, gqa_num_labels) GQA
+    vil_logit: jnp.ndarray  # (B, 1)                       retrieval alignment
+    vil_binary_prediction: Optional[jnp.ndarray]  # (B//2, 2)  NLVR2 pairs
+    vil_tri_prediction: jnp.ndarray  # (B, 3)              SNLI-VE
+    vision_prediction: jnp.ndarray  # (B, Nv, v_target)    masked-region head
+    vision_logit: jnp.ndarray  # (B, Nv, 1)                grounding
+    linguisic_prediction: jnp.ndarray  # (B, Nt', vocab)   masked-LM head
+    linguisic_logit: jnp.ndarray  # (B, Nt', 1)            token grounding
+    attn_data_list: List[Any]  # per-bridge (text→image, image→text) probs
+
+    def to_tuple(self) -> Tuple:
+        """Reference positional order."""
+        return (
+            self.vil_prediction,
+            self.vil_prediction_gqa,
+            self.vil_logit,
+            self.vil_binary_prediction,
+            self.vil_tri_prediction,
+            self.vision_prediction,
+            self.vision_logit,
+            self.linguisic_prediction,
+            self.linguisic_logit,
+            self.attn_data_list,
+        )
+
+
+class ViLBertModel(nn.Module):
+    """Trunk: embeddings + two-stream encoder + poolers."""
+
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.embeddings = TextEmbeddings(cfg, dtype=self.dtype)
+        self.v_embeddings = ImageEmbeddings(cfg, dtype=self.dtype)
+        self.encoder = TwoStreamEncoder(cfg, dtype=self.dtype)
+        self.t_pooler = Pooler(cfg.bi_hidden_size, dtype=self.dtype)
+        self.v_pooler = Pooler(cfg.bi_hidden_size, dtype=self.dtype)
+
+    def __call__(
+        self,
+        input_ids,  # (B, Nt) int32
+        features,  # (B, Nv, v_feature_size)
+        spatials,  # (B, Nv, 5)
+        segment_ids,  # (B, Nt) int32
+        input_mask,  # (B, Nt) {0,1}
+        image_mask,  # (B, Nv) {0,1}
+        task_ids=None,  # (B, 1) int32 when task_specific_tokens
+        *,
+        deterministic: bool = True,
+        collect_attention: bool = False,
+    ):
+        cfg = self.config
+        t_hidden = self.embeddings(
+            input_ids, segment_ids, task_ids, deterministic=deterministic
+        )
+        if cfg.task_specific_tokens:
+            input_mask = TextEmbeddings.extend_mask_for_task_token(input_mask)
+        v_hidden = self.v_embeddings(features, spatials, deterministic=deterministic)
+
+        t_bias = mask_to_bias(input_mask, self.dtype)
+        v_bias = mask_to_bias(image_mask, self.dtype)
+
+        t_seq, v_seq, attn_maps = self.encoder(
+            t_hidden, v_hidden, t_bias, v_bias,
+            deterministic=deterministic, collect_attention=collect_attention,
+        )
+        pooled_t = self.t_pooler(t_seq)
+        pooled_v = self.v_pooler(v_seq)
+        return t_seq, v_seq, pooled_t, pooled_v, attn_maps, input_mask
+
+
+class ViLBertForVLTasks(nn.Module):
+    """Trunk + all 9 heads; output order matches the reference 10-tuple."""
+
+    config: ViLBertConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.bert = ViLBertModel(cfg, dtype=self.dtype)
+        bi = cfg.bi_hidden_size
+        self.vil_prediction = SimpleClassifier(
+            bi * 2, cfg.num_labels, cfg.layer_norm_eps, dtype=self.dtype
+        )
+        self.vil_prediction_gqa = SimpleClassifier(
+            bi * 2, cfg.gqa_num_labels, cfg.layer_norm_eps, dtype=self.dtype
+        )
+        self.vil_binary_prediction = SimpleClassifier(
+            bi * 2, 2, cfg.layer_norm_eps, dtype=self.dtype
+        )
+        self.vil_logit = nn.Dense(1, dtype=self.dtype)
+        self.vil_tri_prediction = nn.Dense(3, dtype=self.dtype)
+        self.vision_logit = nn.Dense(1, dtype=self.dtype)
+        self.linguisic_logit = nn.Dense(1, dtype=self.dtype)
+        self.cls_text = TextPredictionHead(cfg, dtype=self.dtype)
+        self.cls_image = ImagePredictionHead(cfg, dtype=self.dtype)
+        self.head_dropout = nn.Dropout(0.1)
+
+    def __call__(
+        self,
+        input_ids,
+        features,
+        spatials,
+        segment_ids,
+        input_mask,
+        image_mask,
+        co_attention_mask=None,  # accepted for contract parity; zeros in serving
+        task_ids=None,
+        *,
+        deterministic: bool = True,
+        output_all_attention_masks: bool = False,
+    ) -> ViLBertOutput:
+        cfg = self.config
+        t_seq, v_seq, pooled_t, pooled_v, attn_maps, _ = self.bert(
+            input_ids, features, spatials, segment_ids, input_mask, image_mask,
+            task_ids,
+            deterministic=deterministic,
+            collect_attention=output_all_attention_masks,
+        )
+
+        if cfg.fusion_method == "mul":
+            pooled = pooled_t * pooled_v
+        elif cfg.fusion_method == "sum":
+            pooled = pooled_t + pooled_v
+        else:
+            raise ValueError(f"unknown fusion_method {cfg.fusion_method}")
+        pooled = self.head_dropout(pooled, deterministic=deterministic)
+
+        vil_prediction = self.vil_prediction(pooled)
+        vil_prediction_gqa = self.vil_prediction_gqa(pooled)
+        vil_logit = self.vil_logit(pooled)
+        vil_tri_prediction = self.vil_tri_prediction(pooled)
+
+        # NLVR2: adjacent rows are the image pair for one example
+        # (repeat-batching at engine/dispatch.py, mirroring worker.py:266-276).
+        vil_binary_prediction = None
+        if pooled.shape[0] % 2 == 0:
+            paired = pooled.reshape(pooled.shape[0] // 2, -1)
+            vil_binary_prediction = self.vil_binary_prediction(paired)
+        elif self.is_initializing():
+            # Materialize the head's params even when init ran with an odd
+            # batch, so param existence never depends on the init shapes.
+            self.vil_binary_prediction(
+                jnp.zeros((1, 2 * pooled.shape[-1]), self.dtype)
+            )
+
+        # Grounding heads: mask penalty keeps padded regions out of the softmax
+        # (same -10000 fold-in the reference model applies).
+        vision_logit = self.vision_logit(self.head_dropout(
+            v_seq, deterministic=deterministic))
+        vision_logit = vision_logit + mask_to_bias(image_mask, self.dtype)[:, 0, 0, :, None]
+        linguisic_logit = self.linguisic_logit(self.head_dropout(
+            t_seq, deterministic=deterministic))
+
+        linguisic_prediction = self.cls_text(t_seq, self.bert.embeddings.word_table)
+        vision_prediction = self.cls_image(v_seq)
+
+        return ViLBertOutput(
+            vil_prediction=vil_prediction,
+            vil_prediction_gqa=vil_prediction_gqa,
+            vil_logit=vil_logit,
+            vil_binary_prediction=vil_binary_prediction,
+            vil_tri_prediction=vil_tri_prediction,
+            vision_prediction=vision_prediction,
+            vision_logit=vision_logit,
+            linguisic_prediction=linguisic_prediction,
+            linguisic_logit=linguisic_logit,
+            attn_data_list=attn_maps,
+        )

@@ -1,0 +1,132 @@
+"""Attention primitives shared by both streams and the co-attention bridge.
+
+TPU-first choices:
+- fused QKV projection (one MXU matmul instead of three skinny ones),
+- einsum-based multi-head attention that XLA fuses into batched MXU ops,
+- additive mask bias computed once per call in the compute dtype,
+- probabilities optionally returned for the reference's ``visualization`` /
+  ``output_all_attention_masks`` contract (reference worker.py:288).
+
+Reference capability: the torch self-attention inside the external ``vilbert``
+package (driven from worker.py:286-289); redesigned, not translated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def mask_to_bias(mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """(B, N) {0,1} mask → (B, 1, 1, N) additive bias.
+
+    Uses the BERT-family -10000 penalty (the reference model family's exact
+    constant) rather than -inf so bf16 softmax stays finite.
+    """
+    bias = (1.0 - mask.astype(dtype)) * -10000.0
+    return bias[:, None, None, :]
+
+
+def multi_head_attention(
+    q: jnp.ndarray,  # (B, Nq, H, D)
+    k: jnp.ndarray,  # (B, Nk, H, D)
+    v: jnp.ndarray,  # (B, Nk, H, D)
+    bias: Optional[jnp.ndarray],  # broadcastable to (B, H, Nq, Nk)
+    *,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    dropout_rng=None,
+    dtype=jnp.float32,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (context (B, Nq, H, D), probs (B, H, Nq, Nk))."""
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(depth, dtype=dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=dtype)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(dtype)
+    # softmax in fp32 for numerical stability under bf16 compute
+    probs = jnp.asarray(
+        nn.softmax(scores.astype(jnp.float32), axis=-1), dtype=dtype
+    )
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs_dropped = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    else:
+        probs_dropped = probs
+    context = jnp.einsum("bhqk,bkhd->bqhd", probs_dropped, v, preferred_element_type=dtype)
+    return context, probs
+
+
+class FusedSelfAttention(nn.Module):
+    """BERT-style self-attention with a fused QKV matmul.
+
+    ``num_heads * head_dim == hidden`` always holds for both streams
+    (768/12 and 1024/8 in the serving config).
+    """
+
+    hidden_size: int
+    num_heads: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask_bias, *, deterministic: bool = True):
+        head_dim = self.hidden_size // self.num_heads
+        qkv = nn.Dense(3 * self.hidden_size, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (*x.shape[:-1], self.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        dropout_rng = None
+        if not deterministic and self.dropout_rate > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        ctx, probs = multi_head_attention(
+            q, k, v, mask_bias,
+            dropout_rate=self.dropout_rate,
+            deterministic=deterministic,
+            dropout_rng=dropout_rng,
+            dtype=self.dtype,
+        )
+        ctx = ctx.reshape(*x.shape[:-1], self.hidden_size)
+        return ctx, probs
+
+
+class CrossAttention(nn.Module):
+    """One direction of co-attention: queries from ``x``, keys/values from ``y``.
+
+    Projects both operands into the shared ``bi_hidden`` space. The connection
+    layer instantiates this twice — text→image and image→text — each direction
+    with its own independent Q/K/V projections (matching the reference model
+    family, whose bi-attention keeps per-stream projection weights).
+    """
+
+    bi_hidden_size: int
+    num_heads: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, y, y_mask_bias, *, deterministic: bool = True):
+        head_dim = self.bi_hidden_size // self.num_heads
+        q = nn.Dense(self.bi_hidden_size, dtype=self.dtype, name="query")(x)
+        k = nn.Dense(self.bi_hidden_size, dtype=self.dtype, name="key")(y)
+        v = nn.Dense(self.bi_hidden_size, dtype=self.dtype, name="value")(y)
+        B, Nq = x.shape[0], x.shape[1]
+        Nk = y.shape[1]
+        q = q.reshape(B, Nq, self.num_heads, head_dim)
+        k = k.reshape(B, Nk, self.num_heads, head_dim)
+        v = v.reshape(B, Nk, self.num_heads, head_dim)
+        dropout_rng = None
+        if not deterministic and self.dropout_rate > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        ctx, probs = multi_head_attention(
+            q, k, v, y_mask_bias,
+            dropout_rate=self.dropout_rate,
+            deterministic=deterministic,
+            dropout_rng=dropout_rng,
+            dtype=self.dtype,
+        )
+        return ctx.reshape(B, Nq, self.bi_hidden_size), probs

@@ -1,0 +1,122 @@
+"""Feature store round-trips + NMS semantics vs a straightforward
+numpy reference implementation (seam: reference worker.py:123-176, 209-216)."""
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+from vilbert_multitask_tpu.features.store import (
+    FeatureStore,
+    image_key,
+    load_reference_npy,
+    load_vlfr,
+    save_reference_npy,
+    save_vlfr,
+)
+
+
+def _region(n=7, d=16, seed=0):
+    rs = np.random.RandomState(seed)
+    xy = rs.rand(n, 2) * 50
+    wh = rs.rand(n, 2) * 50 + 5
+    return RegionFeatures(
+        features=rs.randn(n, d).astype(np.float32),
+        boxes=np.concatenate([xy, xy + wh], 1).astype(np.float32),
+        image_width=120,
+        image_height=80,
+    )
+
+
+class TestStore:
+    def test_npy_roundtrip(self, tmp_path):
+        r = _region()
+        save_reference_npy(str(tmp_path / "img1.npy"), r, "img1")
+        r2 = load_reference_npy(str(tmp_path / "img1.npy"))
+        np.testing.assert_allclose(r2.features, r.features)
+        np.testing.assert_allclose(r2.boxes, r.boxes)
+        assert (r2.image_width, r2.image_height) == (120, 80)
+        assert r2.num_boxes == r.num_boxes
+
+    def test_vlfr_roundtrip(self, tmp_path):
+        r = _region(seed=1)
+        save_vlfr(str(tmp_path / "img2.vlfr"), r)
+        r2 = load_vlfr(str(tmp_path / "img2.vlfr"))
+        np.testing.assert_allclose(r2.features, r.features)
+        np.testing.assert_allclose(r2.boxes, r.boxes)
+
+    def test_store_lookup_and_cache(self, tmp_path):
+        r = _region(seed=2)
+        save_reference_npy(str(tmp_path / "COCO_123.npy"), r, "COCO_123")
+        store = FeatureStore(str(tmp_path), max_cached=2)
+        got = store.get("/media/demo/COCO_123.jpg")
+        np.testing.assert_allclose(got.features, r.features)
+        assert store.get("/elsewhere/COCO_123.png") is got  # cache hit
+        with pytest.raises(FileNotFoundError):
+            store.get("/media/demo/missing.jpg")
+
+    def test_image_key(self):
+        assert image_key("/a/b/COCO_test.weird.jpg") == "COCO_test"
+
+
+def _numpy_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or suppressed[j]:
+                continue
+            # iou
+            lt = np.maximum(boxes[i, :2], boxes[j, :2])
+            rb = np.minimum(boxes[i, 2:], boxes[j, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[0] * wh[1]
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            b = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a + b - inter) > thresh:
+                suppressed[j] = True
+    return sorted(keep)
+
+
+class TestNMS:
+    def test_matches_numpy_reference(self):
+        from vilbert_multitask_tpu.ops.nms import nms_mask
+
+        rs = np.random.RandomState(0)
+        for seed in range(5):
+            rs = np.random.RandomState(seed)
+            n = 40
+            xy = rs.rand(n, 2) * 60
+            wh = rs.rand(n, 2) * 40 + 2
+            boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+            scores = rs.rand(n).astype(np.float32)
+            got = np.where(np.asarray(nms_mask(boxes, scores, 0.5)))[0].tolist()
+            want = _numpy_nms(boxes, scores, 0.5)
+            assert got == want, f"seed {seed}"
+
+    def test_select_top_regions(self):
+        from vilbert_multitask_tpu.ops.nms import select_top_regions
+
+        rs = np.random.RandomState(3)
+        n, c = 30, 6
+        xy = rs.rand(n, 2) * 60
+        wh = rs.rand(n, 2) * 40 + 2
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        logits = rs.randn(n, c).astype(np.float32)
+        scores = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        keep, num_valid, max_conf, objects, cls_prob = select_top_regions(
+            boxes, scores, num_keep=10
+        )
+        assert keep.shape == (10,)
+        assert 0 < int(num_valid) <= 10
+        # top boxes sorted by descending surviving confidence
+        confs = np.asarray(max_conf)[np.asarray(keep)]
+        assert (np.diff(confs) <= 1e-6).all()
+        # objects exclude the background column (col 0)
+        assert np.asarray(objects).max() < c - 1
+        np.testing.assert_allclose(
+            np.asarray(cls_prob), scores[np.asarray(keep), 1:].max(1), rtol=1e-6
+        )

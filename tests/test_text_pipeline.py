@@ -1,0 +1,123 @@
+"""Tokenizer + input-pipeline parity tests (seams: reference worker.py:402-414
+text prep and worker.py:426-449 spatial construction)."""
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.features.pipeline import (
+    RegionFeatures,
+    batch_images,
+    build_spatials,
+    encode_image,
+)
+from vilbert_multitask_tpu.text.pipeline import (
+    encode_question,
+    reformat_guesswhat_dialog,
+)
+from vilbert_multitask_tpu.text.wordpiece import FullTokenizer, demo_vocab
+
+
+@pytest.fixture(scope="module")
+def tok():
+    vocab = demo_vocab(extra_words=["un", "want", "runn"])
+    return FullTokenizer(vocab)
+
+
+class TestWordPiece:
+    def test_greedy_longest_match(self, tok):
+        # classic wordpiece example: unseen words split into known pieces
+        assert tok.tokenize("unwanted") == ["un", "##want", "##ed"]
+        assert tok.tokenize("running") == ["runn", "##ing"]
+
+    def test_lowercase_and_punct_split(self, tok):
+        assert tok.tokenize("What, is") == ["what", ",", "is"]
+
+    def test_unknown_word_maps_to_unk(self, tok):
+        # ascii chars are all in the demo vocab, so use a non-ascii word
+        ids = tok.encode("ωψφ")
+        assert ids == [tok.vocab["[UNK]"]]
+
+    def test_specials_roundtrip(self, tok):
+        ids = tok.add_special_tokens_single_sentence(tok.encode("what is a dog"))
+        toks = tok.convert_ids_to_tokens(ids)
+        assert toks[0] == "[CLS]" and toks[-1] == "[SEP]"
+        assert tok.detokenize(["runn", "##ing", "dog"]) == ["running", "dog"]
+
+
+class TestEncodeQuestion:
+    def test_pad_appends(self, tok):
+        enc = encode_question(tok, "what is a dog", max_len=10)
+        n = int(enc.input_mask.sum())
+        # append-padding: real tokens first, zeros after (worker.py:409-413)
+        assert enc.input_ids.shape == (10,)
+        assert (enc.input_ids[n:] == 0).all()
+        assert (enc.input_mask[:n] == 1).all() and (enc.input_mask[n:] == 0).all()
+        assert (enc.segment_ids == 0).all()
+        assert enc.input_ids[0] == tok.cls_id and enc.input_ids[n - 1] == tok.sep_id
+
+    def test_truncation_keeps_sep(self, tok):
+        enc = encode_question(tok, "what is a dog " * 30, max_len=12)
+        assert enc.input_mask.sum() == 12
+        assert enc.input_ids[-1] == tok.sep_id
+
+    def test_stack_replicates(self, tok):
+        enc = encode_question(tok, "a dog", max_len=8).stack(4)
+        assert enc.input_ids.shape == (4, 8)
+        assert (enc.input_ids == enc.input_ids[0]).all()
+
+    def test_guesswhat_reformat_applied(self, tok):
+        raw = "Q: is it a dog? A: yes Q: is it red? A: no"
+        fixed = reformat_guesswhat_dialog(raw)
+        assert fixed == "start is it a dog? answer yes stop start is it red? answer no stop"
+        e_fixed = encode_question(tok, raw, max_len=37, task_id=16)
+        e_raw = encode_question(tok, raw, max_len=37, task_id=16,
+                                guesswhat_raw_query=True)
+        assert not np.array_equal(e_fixed.input_ids, e_raw.input_ids)
+
+    def test_guesswhat_no_turns_falls_back(self, tok):
+        assert reformat_guesswhat_dialog("just a phrase") == "just a phrase"
+
+
+class TestImagePipeline:
+    def test_spatials_formula(self):
+        boxes = np.array([[10, 20, 110, 220]], np.float32)
+        sp = build_spatials(boxes, image_w=200, image_h=400)
+        np.testing.assert_allclose(sp[0, :4], [0.05, 0.05, 0.55, 0.55])
+        np.testing.assert_allclose(sp[0, 4], (100 * 200) / (200 * 400))
+
+    def test_encode_image_layout(self):
+        n, d = 5, 8
+        feats = np.arange(n * d, dtype=np.float32).reshape(n, d)
+        region = RegionFeatures(
+            features=feats,
+            boxes=np.tile([0, 0, 50, 50], (n, 1)).astype(np.float32),
+            image_width=100, image_height=100,
+        )
+        enc = encode_image(region, max_regions=9)
+        # global = mean of the n real features, prepended (worker.py:432-434)
+        np.testing.assert_allclose(enc.features[0], feats.mean(0))
+        np.testing.assert_allclose(enc.features[1 : n + 1], feats)
+        assert (enc.features[n + 1 :] == 0).all()
+        np.testing.assert_allclose(enc.spatials[0], [0, 0, 1, 1, 1])
+        assert enc.image_mask.sum() == n + 1
+
+    def test_too_many_boxes_raises(self):
+        region = RegionFeatures(
+            features=np.zeros((12, 4), np.float32),
+            boxes=np.zeros((12, 4), np.float32),
+            image_width=10, image_height=10,
+        )
+        with pytest.raises(ValueError):
+            encode_image(region, max_regions=10)
+
+    def test_batch_padding_bucket(self):
+        region = RegionFeatures(
+            features=np.ones((3, 4), np.float32),
+            boxes=np.tile([0, 0, 5, 5], (3, 1)).astype(np.float32),
+            image_width=10, image_height=10,
+        )
+        enc = encode_image(region, max_regions=6)
+        feats, spatials, masks = batch_images([enc, enc], pad_to=4)
+        assert feats.shape == (4, 6, 4)
+        # pad rows attend only their global slot
+        assert masks[2].sum() == 1 and masks[2, 0] == 1

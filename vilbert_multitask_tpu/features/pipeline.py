@@ -1,0 +1,115 @@
+"""Host-side region-feature preprocessing: detector output → fixed-shape
+batch buffers.
+
+Reference capability: the image half of ``custom_prediction`` (reference
+worker.py:421-455):
+
+- mean-pool the region features into a global feature and prepend it
+  (worker.py:432-434);
+- 5-dim spatial encoding per box: [x1/w, y1/h, x2/w, y2/h, area_fraction]
+  with the global box [0, 0, 1, 1, 1] prepended (worker.py:436-444);
+- image mask 1 per real region (worker.py:445);
+- co-attention mask is all zeros at serving time (worker.py:455).
+
+TPU-first divergence: buffers are padded to a static ``max_regions`` (101 =
+100 detector boxes + global, reference worker.py:71,433) so every request
+compiles to the same XLA program; the reference instead shipped whatever
+dynamic shape the detector produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RegionFeatures:
+    """One image's detector output (the `.npy` schema fields that matter,
+    reference worker.py:209-216)."""
+
+    features: np.ndarray  # (num_boxes, feat_dim) fc6 features
+    boxes: np.ndarray  # (num_boxes, 4) absolute xyxy pixel coords
+    image_width: int
+    image_height: int
+    num_boxes: int | None = None  # defaults to features.shape[0]
+
+    def __post_init__(self):
+        if self.num_boxes is None:
+            self.num_boxes = int(self.features.shape[0])
+
+
+@dataclasses.dataclass
+class EncodedImage:
+    """Fixed-shape buffers for one image, ready to batch."""
+
+    features: np.ndarray  # (max_regions, feat_dim) f32
+    spatials: np.ndarray  # (max_regions, 5) f32
+    image_mask: np.ndarray  # (max_regions,) i32
+
+
+def build_spatials(boxes: np.ndarray, image_w: float, image_h: float) -> np.ndarray:
+    """(N, 4) absolute xyxy → (N, 5) normalized [x1, y1, x2, y2, area_frac]."""
+    out = np.zeros((boxes.shape[0], 5), np.float32)
+    out[:, 0] = boxes[:, 0] / image_w
+    out[:, 1] = boxes[:, 1] / image_h
+    out[:, 2] = boxes[:, 2] / image_w
+    out[:, 3] = boxes[:, 3] / image_h
+    out[:, 4] = (
+        (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 2] - boxes[:, 0])
+    ) / (image_w * image_h)
+    return out
+
+
+GLOBAL_BOX = np.array([0.0, 0.0, 1.0, 1.0, 1.0], np.float32)
+
+
+def encode_image(region: RegionFeatures, max_regions: int = 101) -> EncodedImage:
+    """Prepend global feature + pad to ``max_regions``."""
+    n = int(region.num_boxes)
+    feats = np.asarray(region.features[:n], np.float32)
+    if n + 1 > max_regions:
+        raise ValueError(f"{n} boxes + global exceeds max_regions={max_regions}")
+
+    g_feat = feats.sum(axis=0, keepdims=True) / max(n, 1)
+    spatials = build_spatials(np.asarray(region.boxes[:n], np.float32),
+                              float(region.image_width), float(region.image_height))
+
+    feat_dim = feats.shape[1]
+    out_feats = np.zeros((max_regions, feat_dim), np.float32)
+    out_feats[0] = g_feat
+    out_feats[1 : n + 1] = feats
+    out_spatials = np.zeros((max_regions, 5), np.float32)
+    out_spatials[0] = GLOBAL_BOX
+    out_spatials[1 : n + 1] = spatials
+    mask = np.zeros((max_regions,), np.int32)
+    mask[: n + 1] = 1
+    return EncodedImage(out_feats, out_spatials, mask)
+
+
+def batch_images(
+    images: Sequence[EncodedImage], pad_to: int | None = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stack per-image buffers into (B, ...) arrays, optionally padding the
+    batch dimension to a shape bucket (engine shape-bucket discipline)."""
+    B = len(images)
+    n = pad_to or B
+    if n < B:
+        raise ValueError(f"pad_to={pad_to} smaller than batch {B}")
+    feat_dim = images[0].features.shape[-1]
+    max_regions = images[0].features.shape[0]
+    feats = np.zeros((n, max_regions, feat_dim), np.float32)
+    spatials = np.zeros((n, max_regions, 5), np.float32)
+    masks = np.zeros((n, max_regions), np.int32)
+    for i, img in enumerate(images):
+        feats[i] = img.features
+        spatials[i] = img.spatials
+        masks[i] = img.image_mask
+    # Padded batch rows keep a single attended global region so softmaxes
+    # stay well-defined; results for pad rows are discarded at decode.
+    for i in range(B, n):
+        masks[i, 0] = 1
+        spatials[i, 0] = GLOBAL_BOX
+    return feats, spatials, masks

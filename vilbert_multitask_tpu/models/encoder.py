@@ -98,12 +98,12 @@ class TwoStreamEncoder(nn.Module):
             zip(cfg.v_biattention_id, cfg.t_biattention_id)
         ):
             while t_ptr < t_stop:
-                t_hidden, t_probs = self.t_layers[t_ptr](
+                t_hidden, _ = self.t_layers[t_ptr](
                     t_hidden, t_mask_bias, deterministic=deterministic
                 )
                 t_ptr += 1
             while v_ptr < v_stop:
-                v_hidden, v_probs = self.v_layers[v_ptr](
+                v_hidden, _ = self.v_layers[v_ptr](
                     v_hidden, v_mask_bias, deterministic=deterministic
                 )
                 v_ptr += 1

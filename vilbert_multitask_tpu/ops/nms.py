@@ -1,0 +1,99 @@
+"""Vectorized NMS + top-K region selection in pure JAX.
+
+Reference capability: ``maskrcnn_benchmark.layers.nms`` (a C++/CUDA kernel,
+reference worker.py:51) driven by the per-class box-selection loop at
+worker.py:123-176. Only the offline feature extractor needs this — serving
+reads precomputed features — but the selection semantics must match exactly
+or regenerated features shift boxes and grounding answers (SURVEY.md §7
+"hard parts" (b)).
+
+TPU-first design: greedy NMS is inherently sequential in the number of
+*kept* boxes, so we express it as a ``lax.fori_loop`` over a static box
+count with masked updates (compiler-friendly control flow; no dynamic
+shapes), and vmap it over the ~1600 detector classes instead of the
+reference's Python loop over classes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def box_iou(boxes_a: jnp.ndarray, boxes_b: jnp.ndarray) -> jnp.ndarray:
+    """(N,4) xyxy, (M,4) xyxy → (N,M) IoU matrix."""
+    area_a = (boxes_a[:, 2] - boxes_a[:, 0]) * (boxes_a[:, 3] - boxes_a[:, 1])
+    area_b = (boxes_b[:, 2] - boxes_b[:, 0]) * (boxes_b[:, 3] - boxes_b[:, 1])
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("iou_threshold",))
+def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
+             iou_threshold: float = 0.5) -> jnp.ndarray:
+    """Greedy NMS → (N,) bool keep mask.
+
+    Matches torchvision/maskrcnn semantics: visit boxes in descending score
+    order; keep a box iff it doesn't overlap (IoU > threshold) an
+    already-kept higher-scoring box.
+    """
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_sorted = boxes[order]
+    iou = box_iou(boxes_sorted, boxes_sorted)
+
+    def body(i, keep):
+        # suppressed iff any kept earlier box overlaps it
+        overlap = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
+        return keep.at[i].set(~overlap.any())
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # scatter back to original order
+    return jnp.zeros((n,), bool).at[order].set(keep_sorted)
+
+
+@functools.partial(jax.jit, static_argnames=("num_keep", "iou_threshold",
+                                             "background", "conf_threshold"))
+def select_top_regions(
+    boxes: jnp.ndarray,  # (N, 4) detector proposals, image coords
+    class_scores: jnp.ndarray,  # (N, C) softmaxed class scores, col 0 = background
+    num_keep: int = 100,
+    iou_threshold: float = 0.5,
+    conf_threshold: float = 0.0,
+    background: bool = False,
+):
+    """Per-class NMS → per-box max surviving confidence → top-``num_keep``.
+
+    Vectorized equivalent of the reference selection loop (worker.py:136-163):
+    for each class, run NMS on that class's scores; a box's ``max_conf`` is
+    the best score it achieved in any class where NMS kept it (and the score
+    beat ``conf_threshold``); keep the ``num_keep`` highest. Returns
+    ``(keep_indices (num_keep,), num_valid (), max_conf (N,))`` where
+    ``num_valid`` counts kept boxes with nonzero confidence (worker.py:157).
+
+    Note: the reference also derives ``objects``/``cls_prob`` for the saved
+    schema with a row-slice quirk (``scores[keep_boxes][start_index:]`` drops
+    a *row*, worker.py:162-163); we compute the evidently intended per-box
+    class argmax/max over the non-background *columns* instead.
+    """
+    start = 0 if background else 1
+    per_class = jax.vmap(
+        lambda s: nms_mask(boxes, s, iou_threshold), in_axes=1, out_axes=1
+    )(class_scores[:, start:])  # (N, C-start) keep masks
+    eligible = per_class & (class_scores[:, start:] > conf_threshold)
+    max_conf = jnp.max(
+        jnp.where(eligible, class_scores[:, start:], 0.0), axis=1
+    )  # (N,)
+
+    top_conf, keep_indices = jax.lax.top_k(max_conf, num_keep)
+    num_valid = jnp.sum(top_conf > 0)
+
+    objects = jnp.argmax(class_scores[keep_indices, start:], axis=1)
+    cls_prob = jnp.max(class_scores[keep_indices, start:], axis=1)
+    return keep_indices, num_valid, max_conf, objects, cls_prob

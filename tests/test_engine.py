@@ -1,0 +1,161 @@
+"""Engine tests: every served task type end-to-end on a tiny model (CPU),
+bucket padding invariance, and mesh-sharded execution on the virtual
+8-device mesh (SURVEY.md §4 device-test strategy)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.config import (
+    EngineConfig,
+    FrameworkConfig,
+    MeshConfig,
+    TASK_REGISTRY,
+)
+from vilbert_multitask_tpu.engine import InferenceEngine
+from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+from vilbert_multitask_tpu.parallel import build_mesh, param_specs
+
+
+def make_regions(n, num_boxes=7, feat_dim=32, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        boxes = rng.uniform(0, 200, size=(num_boxes, 4)).astype(np.float32)
+        boxes[:, 2:] = boxes[:, :2] + 10 + boxes[:, 2:] * 0.3
+        out.append(
+            RegionFeatures(
+                features=rng.randn(num_boxes, feat_dim).astype(np.float32),
+                boxes=np.clip(boxes, 0, 640),
+                image_width=640,
+                image_height=480,
+            )
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_config):
+    cfg = FrameworkConfig(
+        model=tiny_config,
+        engine=EngineConfig(compute_dtype="float32", max_regions=11),
+    )
+    return InferenceEngine(cfg, seed=0)
+
+
+TASK_QUESTIONS = {
+    1: "what is the man holding",
+    2: "what color is the car",
+    15: "is the bowl right of the mug",
+    4: "which object can you eat",
+    11: "the woman in the red coat",
+    16: "q: is it a person? a: no q: is it red? a: yes",
+    13: "two dogs are playing in the snow",
+    12: "both images contain two wolves",
+    7: "a man riding a horse on the beach",
+}
+
+
+@pytest.mark.parametrize("task_id", sorted(TASK_REGISTRY))
+def test_all_tasks_end_to_end(engine, task_id):
+    spec = TASK_REGISTRY[task_id]
+    n = spec.min_images
+    regions = make_regions(n, feat_dim=engine.cfg.model.v_feature_size)
+    req = engine.prepare(task_id, TASK_QUESTIONS[task_id], regions)
+    _, result = engine.run(req)
+    assert result.task_id == task_id
+    assert result.kind == spec.decode
+    if spec.decode in ("labels", "binary", "trinary"):
+        assert len(result.answers) == min(
+            spec.top_k, {"binary": 2, "trinary": 3}.get(spec.decode, spec.top_k)
+        )
+        confs = [a["confidence"] for a in result.answers]
+        assert confs == sorted(confs, reverse=True)
+        assert all(0.0 <= c <= 1.0 for c in confs)
+    elif spec.decode == "grounding":
+        assert len(result.boxes) == spec.top_k
+        for b in result.boxes:
+            x1, y1, x2, y2 = b["box_xyxy"]
+            assert 0 <= x1 <= 640 and 0 <= y2 <= 480 or b["is_global"]
+    elif spec.decode == "ranking":
+        assert len(result.ranking) == n
+        assert [r["rank"] for r in result.ranking] == list(range(1, n + 1))
+
+
+def test_retrieval_bucket_padding_invariance(engine):
+    """3 candidates pad to the 4-bucket; scores of real rows must match an
+    unpadded 2-candidate run row-for-row (pad rows never leak into decode)."""
+    feat_dim = engine.cfg.model.v_feature_size
+    regions = make_regions(3, feat_dim=feat_dim, seed=1)
+    req3 = engine.prepare(7, "a dog on a beach", regions)
+    assert req3.bucket == 4 and req3.n_images == 3
+    _, res3 = engine.run(req3)
+    assert len(res3.ranking) == 3
+
+    req2 = engine.prepare(7, "a dog on a beach", regions[:2])
+    assert req2.bucket == 2
+    _, res2 = engine.run(req2)
+    score3 = {r["image"]: r["score"] for r in res3.ranking}
+    score2 = {r["image"]: r["score"] for r in res2.ranking}
+    for k, v in score2.items():
+        assert score3[k] == pytest.approx(v, abs=1e-4)
+
+
+def test_nlvr2_requires_two_images(engine):
+    regions = make_regions(1, feat_dim=engine.cfg.model.v_feature_size)
+    with pytest.raises(ValueError, match="task 12"):
+        engine.prepare(12, "both images", regions)
+
+
+def test_guesswhat_dialog_reformat_changes_tokens(engine):
+    """Task 16 reformats Q/A dialogs (fixing the reference's dead code,
+    SURVEY.md §2.4) — its ids must differ from the raw-encoded query."""
+    regions = make_regions(1, feat_dim=engine.cfg.model.v_feature_size)
+    q = "q: is it a person? a: no"
+    req16 = engine.prepare(16, q, regions)
+    req11 = engine.prepare(11, q, regions)
+    assert not np.array_equal(req16.text.input_ids, req11.text.input_ids)
+
+
+def test_mesh_sharded_engine_matches_single_device(tiny_config):
+    """dp×tp sharded run (virtual 8-device mesh) must reproduce the
+    single-device logits — XLA collectives only change placement."""
+    cfg = FrameworkConfig(
+        model=tiny_config,
+        engine=EngineConfig(compute_dtype="float32", max_regions=11),
+        mesh=MeshConfig(dp=4, tp=2),
+    )
+    base = InferenceEngine(cfg, seed=3)
+    mesh = build_mesh(cfg.mesh)
+    sharded = InferenceEngine(cfg, seed=3, mesh=mesh)
+
+    regions = make_regions(2, feat_dim=cfg.model.v_feature_size, seed=5)
+    req_a = base.prepare(12, "both images contain wolves", regions)
+    req_b = sharded.prepare(12, "both images contain wolves", regions)
+    out_a, res_a = base.run(req_a)
+    out_b, res_b = sharded.run(req_b)
+    np.testing.assert_allclose(
+        np.asarray(out_a.vil_binary_prediction),
+        np.asarray(out_b.vil_binary_prediction), atol=1e-4,
+    )
+    assert [a["answer"] for a in res_a.answers] == [
+        a["answer"] for a in res_b.answers
+    ]
+
+
+def test_partition_rules_shard_big_matmuls(tiny_config):
+    """TP rules must actually shard the FFN/QKV kernels when dims divide."""
+    cfg = FrameworkConfig(
+        model=tiny_config, engine=EngineConfig(compute_dtype="float32"),
+        mesh=MeshConfig(dp=4, tp=2),
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    mesh = build_mesh(cfg.mesh)
+    specs = param_specs(eng.params, mesh)
+    qkv = specs["bert"]["encoder"]["t_layer_0"]["attention"]["qkv"]["kernel"]
+    assert tuple(qkv) == (None, "tp")
+    ffn_out = specs["bert"]["encoder"]["t_layer_0"]["ffn"]["output"]["kernel"]
+    assert tuple(ffn_out) == ("tp", None)
+    norm = specs["bert"]["encoder"]["t_layer_0"]["ffn"]["norm"]["scale"]
+    assert tuple(norm) == ()

@@ -1,0 +1,266 @@
+"""TPU serving runtime: bucketed, jit-compiled ViLBERT inference.
+
+Reference capability: the worker's model-driving core — ``load_vilbert_model``
+(reference worker.py:463-539), ``custom_prediction`` (worker.py:388-458) and
+``prediction`` (worker.py:248-386) — redesigned around XLA's compilation
+model:
+
+- **static shape buckets**: text is always ``max_text_len`` (37), regions
+  ``max_regions`` (101), and the image/batch axis is padded to one of
+  ``EngineConfig.image_buckets`` — every request hits a program compiled
+  once, instead of the reference's shape-per-request dynamic batching
+  (worker.py:266-284);
+- **repeat-batching stays**: NLVR2 pairs and retrieval candidates score in a
+  single forward with the question replicated per image row, mirroring
+  worker.py:266-284;
+- **bf16 compute / f32 params** on the MXU; softmaxes run in f32;
+- **mesh-ready**: pass a ``Mesh`` and params are placed via the partition
+  rules in :mod:`..parallel.sharding`; without one, single-device jit;
+- label maps load once at boot (fixes the per-request pickle reload,
+  SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vilbert_multitask_tpu.config import (
+    FrameworkConfig,
+    TASK_REGISTRY,
+    TaskSpec,
+)
+from vilbert_multitask_tpu.engine import decode as dec
+from vilbert_multitask_tpu.engine.labels import LabelMapStore
+from vilbert_multitask_tpu.features.pipeline import (
+    EncodedImage,
+    RegionFeatures,
+    batch_images,
+    encode_image,
+)
+from vilbert_multitask_tpu.features.store import FeatureStore
+from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks, ViLBertOutput
+from vilbert_multitask_tpu.parallel import sharding as shd
+from vilbert_multitask_tpu.text.pipeline import EncodedText, encode_question
+from vilbert_multitask_tpu.text.wordpiece import FullTokenizer, demo_vocab
+
+
+@dataclasses.dataclass
+class PreparedRequest:
+    """Host-side buffers for one request, already bucketed."""
+
+    spec: TaskSpec
+    n_images: int
+    bucket: int
+    text: EncodedText  # (bucket, Nt)
+    features: np.ndarray  # (bucket, Nv, D)
+    spatials: np.ndarray  # (bucket, Nv, 5)
+    image_mask: np.ndarray  # (bucket, Nv)
+    task_ids: np.ndarray  # (bucket, 1)
+    images: List[dec.ImageMeta]
+
+
+class InferenceEngine:
+    """One engine per process: owns params, tokenizer, stores, compile cache."""
+
+    def __init__(
+        self,
+        cfg: Optional[FrameworkConfig] = None,
+        *,
+        params=None,
+        tokenizer: Optional[FullTokenizer] = None,
+        feature_store: Optional[FeatureStore] = None,
+        label_store: Optional[LabelMapStore] = None,
+        mesh=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg or FrameworkConfig()
+        ecfg = self.cfg.engine
+        self.compute_dtype = jnp.dtype(ecfg.compute_dtype)
+        self.model = ViLBertForVLTasks(self.cfg.model, dtype=self.compute_dtype)
+        self.tokenizer = tokenizer or FullTokenizer(demo_vocab())
+        self.feature_store = feature_store
+        self.labels = label_store or LabelMapStore(
+            sizes={"vqa": self.cfg.model.num_labels,
+                   "gqa": self.cfg.model.gqa_num_labels}
+        )
+        self.mesh = mesh
+        if params is None:
+            params = self.init_params(jax.random.PRNGKey(seed))
+        if mesh is not None:
+            params = shd.shard_params(params, mesh)
+        self.params = params
+        self._compiled: Dict[Tuple[int, bool], callable] = {}
+        self.stage_times: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------ init
+    def _dummy_batch(self, batch: int):
+        ecfg, mcfg = self.cfg.engine, self.cfg.model
+        return dict(
+            input_ids=jnp.zeros((batch, ecfg.max_text_len), jnp.int32),
+            features=jnp.zeros((batch, ecfg.max_regions, mcfg.v_feature_size),
+                               jnp.float32),
+            spatials=jnp.zeros((batch, ecfg.max_regions, 5), jnp.float32),
+            segment_ids=jnp.zeros((batch, ecfg.max_text_len), jnp.int32),
+            input_mask=jnp.ones((batch, ecfg.max_text_len), jnp.int32),
+            image_mask=jnp.ones((batch, ecfg.max_regions), jnp.int32),
+            task_ids=jnp.zeros((batch, 1), jnp.int32),
+        )
+
+    def init_params(self, rng):
+        """Random init (even batch so the paired NLVR2 head materializes)."""
+        d = self._dummy_batch(2)
+        variables = self.model.init(
+            rng, d["input_ids"], d["features"], d["spatials"], d["segment_ids"],
+            d["input_mask"], d["image_mask"], None, d["task_ids"],
+            deterministic=True,
+        )
+        # Params live in f32; compute casts to bf16 inside the model.
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32)
+            if jnp.issubdtype(x.dtype, jnp.floating) else np.asarray(x),
+            variables["params"],
+        )
+
+    # -------------------------------------------------------------- compile
+    def _forward(self, bucket: int, collect_attention: bool):
+        key = (bucket, collect_attention)
+        if key not in self._compiled:
+            model = self.model
+
+            @partial(jax.jit, static_argnames=("attn",))
+            def fwd(params, batch, attn=collect_attention):
+                return model.apply(
+                    {"params": params},
+                    batch["input_ids"], batch["features"], batch["spatials"],
+                    batch["segment_ids"], batch["input_mask"],
+                    batch["image_mask"], None, batch["task_ids"],
+                    deterministic=True, output_all_attention_masks=attn,
+                )
+
+            self._compiled[key] = fwd
+        return self._compiled[key]
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile every shape bucket so first requests pay no compile."""
+        for b in buckets or self.cfg.engine.image_buckets:
+            batch = self._dummy_batch(b)
+            if self.mesh is not None:
+                # Match run()'s input shardings exactly — a different input
+                # sharding is a different XLA program (fresh compile).
+                batch = jax.device_put(batch, shd.batch_shardings(batch, self.mesh))
+            out = self._forward(b, False)(self.params, batch)
+            jax.block_until_ready(out.vil_prediction)
+
+    # -------------------------------------------------------------- prepare
+    def prepare(
+        self,
+        task_id: int,
+        question: str,
+        regions: Sequence[RegionFeatures],
+        image_paths: Optional[Sequence[str]] = None,
+    ) -> PreparedRequest:
+        """Host-side preprocessing: validate, tokenize, encode, bucket.
+
+        Mirrors ``custom_prediction`` (worker.py:388-458) + the repeat
+        semantics in ``prediction`` (worker.py:256-284).
+        """
+        if task_id not in TASK_REGISTRY:
+            raise ValueError(f"unknown task_id {task_id}")
+        spec = TASK_REGISTRY[task_id]
+        n = len(regions)
+        spec.validate_num_images(n)
+        ecfg = self.cfg.engine
+        bucket = n if n == 1 else ecfg.bucket_for(n)
+
+        text = encode_question(
+            self.tokenizer, question, ecfg.max_text_len, task_id=task_id,
+            lowercase=self.cfg.serving.lowercase_questions,
+        ).stack(bucket)
+        encoded = [encode_image(r, ecfg.max_regions) for r in regions]
+        feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
+        task_ids = np.full((bucket, 1), task_id, np.int32)
+        paths = list(image_paths or [f"image_{i}" for i in range(n)])
+        if len(paths) != n:
+            raise ValueError(
+                f"got {len(paths)} image paths for {n} feature sets"
+            )
+        images = [
+            dec.ImageMeta(p, r.image_width, r.image_height)
+            for p, r in zip(paths, regions)
+        ]
+        return PreparedRequest(spec, n, bucket, text, feats, spatials,
+                               image_mask, task_ids, images)
+
+    # ---------------------------------------------------------------- decode
+    def decode(self, req: PreparedRequest, out: ViLBertOutput) -> dec.TaskResult:
+        spec = req.spec
+        if spec.decode == "labels":
+            head = getattr(out, spec.head)
+            return dec.decode_labels(spec, np.asarray(head, np.float32)[0],
+                                     self.labels)
+        if spec.decode == "binary":
+            return dec.decode_binary(
+                spec, np.asarray(out.vil_binary_prediction, np.float32)[0])
+        if spec.decode == "trinary":
+            return dec.decode_trinary(
+                spec, np.asarray(out.vil_tri_prediction, np.float32)[0])
+        if spec.decode == "ranking":
+            return dec.decode_ranking(
+                spec, np.asarray(out.vil_logit, np.float32), req.images)
+        if spec.decode == "grounding":
+            return dec.decode_grounding(
+                spec, np.asarray(out.vision_logit, np.float32)[0],
+                req.spatials[0], req.images[0])
+        raise ValueError(f"unknown decode family {spec.decode}")
+
+    # ---------------------------------------------------------------- serve
+    def run(self, req: PreparedRequest, *, collect_attention: bool = False):
+        """Device forward for a prepared request → (output, decoded result)."""
+        batch = dict(
+            input_ids=req.text.input_ids, features=req.features,
+            spatials=req.spatials, segment_ids=req.text.segment_ids,
+            input_mask=req.text.input_mask, image_mask=req.image_mask,
+            task_ids=req.task_ids,
+        )
+        if self.mesh is not None:
+            batch = jax.device_put(batch, shd.batch_shardings(batch, self.mesh))
+        t0 = time.perf_counter()
+        out = self._forward(req.bucket, collect_attention)(self.params, batch)
+        jax.block_until_ready(out.vil_prediction)
+        self.stage_times["forward_s"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        result = self.decode(req, out)
+        self.stage_times["decode_s"] = time.perf_counter() - t0
+        return out, result
+
+    def predict(
+        self,
+        task_id: int,
+        question: str,
+        image_paths: Sequence[str],
+        *,
+        collect_attention: bool = False,
+    ) -> dec.TaskResult:
+        """Full request path: feature lookup → prepare → forward → decode.
+
+        The library-level equivalent of one queue callback's model section
+        (worker.py:556-576) — requires a ``FeatureStore``.
+        """
+        if self.feature_store is None:
+            raise RuntimeError("predict() needs a FeatureStore; use "
+                               "prepare()+run() with in-memory regions instead")
+        t0 = time.perf_counter()
+        regions = self.feature_store.get_batch(image_paths)
+        self.stage_times["features_s"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        req = self.prepare(task_id, question, regions, image_paths)
+        self.stage_times["prepare_s"] = time.perf_counter() - t0
+        _, result = self.run(req, collect_attention=collect_attention)
+        return result

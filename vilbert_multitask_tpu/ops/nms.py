@@ -74,8 +74,11 @@ def select_top_regions(
     for each class, run NMS on that class's scores; a box's ``max_conf`` is
     the best score it achieved in any class where NMS kept it (and the score
     beat ``conf_threshold``); keep the ``num_keep`` highest. Returns
-    ``(keep_indices (num_keep,), num_valid (), max_conf (N,))`` where
-    ``num_valid`` counts kept boxes with nonzero confidence (worker.py:157).
+    ``(keep_indices (num_keep,), num_valid (), max_conf (N,), objects
+    (num_keep,), cls_prob (num_keep, C-start))`` where ``num_valid`` counts
+    kept boxes with nonzero confidence (worker.py:157) and ``objects`` /
+    ``cls_prob`` are the per-kept-box class argmax / score rows for the saved
+    ``.npy`` schema (worker.py:209-216).
 
     Note: the reference also derives ``objects``/``cls_prob`` for the saved
     schema with a row-slice quirk (``scores[keep_boxes][start_index:]`` drops

@@ -1,0 +1,24 @@
+"""Device-mesh parallelism: mesh construction + partition rules.
+
+The TPU-native replacement for the reference's (absent) distributed stack —
+see SURVEY.md §2.3.
+"""
+
+from vilbert_multitask_tpu.parallel.mesh import build_mesh, local_mesh_info
+from vilbert_multitask_tpu.parallel.sharding import (
+    batch_shardings,
+    batch_spec,
+    param_shardings,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "build_mesh",
+    "local_mesh_info",
+    "batch_shardings",
+    "batch_spec",
+    "param_shardings",
+    "param_specs",
+    "shard_params",
+]

@@ -1,0 +1,56 @@
+"""Device-mesh construction.
+
+The reference has no device-level parallelism at all — one model on one CUDA
+device (reference worker.py:87,536; SURVEY.md §2.3). Here a
+``jax.sharding.Mesh`` over ICI is first-class: a 2-D ``(dp, tp)`` layout where
+``dp`` shards request batches and ``tp`` shards weight matrices
+(Megatron-style) for checkpoints too large to replicate. Multi-host extends
+the same mesh over DCN via ``jax.distributed`` — tensors ride ICI within a
+slice; cross-host work distribution stays on the job queue, mirroring the
+reference's queue boundary (demo/sender.py:26-31).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from vilbert_multitask_tpu.config import MeshConfig
+
+
+def build_mesh(
+    cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a ``(dp, tp)`` mesh from the config over the given devices.
+
+    ``dp == -1`` means "all remaining devices after tp" — the serving default,
+    so one binary works on 1-chip dev boxes and full slices alike.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    tp = max(1, cfg.tp)
+    if cfg.dp > 0:
+        dp = cfg.dp
+    else:
+        if len(devices) % tp:
+            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
+        dp = len(devices) // tp
+    if dp * tp > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{tp} needs {dp * tp} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(grid, tuple(cfg.axis_names))
+
+
+def local_mesh_info(mesh: Mesh) -> dict:
+    """Small debug/observability summary (exported by the metrics endpoint)."""
+    return {
+        "axis_names": list(mesh.axis_names),
+        "shape": dict(mesh.shape),
+        "n_devices": int(np.prod(list(mesh.shape.values()))),
+        "device_kinds": sorted({d.device_kind for d in mesh.devices.flat}),
+    }

@@ -1,0 +1,107 @@
+"""Parameter & activation partition rules (Megatron-style TP + DP batches).
+
+The reference's "distributed backend" is NCCL-free and nonexistent
+(SURVEY.md §2.3); this module IS the TPU-native replacement: declarative
+``PartitionSpec`` rules that ``jax.jit`` lowers to XLA collectives over ICI.
+
+Layout (standard two-matmul transformer sharding, à la scaling-book):
+- expanding matmuls (fused QKV, FFN ``intermediate``, cross-attention
+  Q/K/V, classifier ``dense1``) shard their OUTPUT dim on ``tp``;
+- contracting matmuls (attention-output ``dense``, FFN ``output``,
+  classifier ``dense2``) shard their INPUT dim on ``tp`` — XLA inserts the
+  closing ``psum`` on the residual add;
+- the word-embedding table (and its tied LM decoder) shards the vocab dim;
+- everything else (LayerNorms, biases of contracting matmuls, poolers,
+  small heads) is replicated;
+- activations shard batch on ``dp`` everywhere.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (regex over "/"-joined param path, spec). First match wins; paths end with
+# the leaf name (kernel/bias/embedding/scale/...).
+_RULES: List[Tuple[str, P]] = [
+    # --- expanding matmuls: shard output features ---
+    (r".*/attention/qkv/kernel$", P(None, "tp")),
+    (r".*/attention/qkv/bias$", P("tp")),
+    (r".*/(text_attends_image|image_attends_text)/(query|key|value)/kernel$",
+     P(None, "tp")),
+    (r".*/(text_attends_image|image_attends_text)/(query|key|value)/bias$",
+     P("tp")),
+    (r".*/ffn/intermediate/kernel$", P(None, "tp")),
+    (r".*/ffn/intermediate/bias$", P("tp")),
+    (r".*/dense1/kernel$", P(None, "tp")),
+    (r".*/dense1/bias$", P("tp")),
+    # --- contracting matmuls: shard input features, replicate bias ---
+    (r".*/attention_output/dense/kernel$", P("tp", None)),
+    (r".*/(v_output|t_output)/dense/kernel$", P("tp", None)),
+    (r".*/ffn/output/kernel$", P("tp", None)),
+    (r".*/dense2/kernel$", P("tp", None)),
+    # --- vocab-sharded embedding (tied LM decoder shards with it) ---
+    (r".*/word_embeddings/embedding$", P("tp", None)),
+    (r".*/cls_text/decoder_bias$", P("tp")),
+    # --- default: replicated ---
+    (r".*", P()),
+]
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def _spec_fits(spec: P, shape, mesh: Mesh) -> bool:
+    for dim, axis in zip(shape, spec):
+        if axis is None:
+            continue
+        if dim % mesh.shape[axis]:
+            return False
+    return True
+
+
+def param_specs(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree for a param tree. Rules whose sharded dim does not
+    divide the mesh axis fall back to replication (tiny test configs)."""
+
+    def spec_for(path, leaf):
+        p = _path_str(path)
+        for pattern, spec in _RULES:
+            if re.match(pattern, p):
+                if len(spec) > leaf.ndim or not _spec_fits(spec, leaf.shape, mesh):
+                    return P()
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(params, mesh)
+    )
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a host param tree onto the mesh per the rules (one-time at boot)."""
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def batch_spec() -> P:
+    """Activations: batch dim sharded over dp, everything else replicated."""
+    return P("dp")
+
+
+def batch_shardings(batch: Any, mesh: Mesh) -> Any:
+    """NamedShardings for a batch pytree: shard axis 0 on dp when divisible."""
+
+    def one(leaf):
+        if leaf.ndim and leaf.shape[0] % mesh.shape["dp"] == 0:
+            return NamedSharding(mesh, P("dp"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(one, batch)

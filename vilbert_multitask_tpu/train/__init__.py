@@ -1,0 +1,43 @@
+"""Training subsystem: multi-task losses + sharded train step.
+
+The reference demo is inference-only, but its checkpoint is the product of
+the 12-in-1 multi-task training regime (paper cited at reference README.md:6;
+the training-side loaders the worker imports but never calls are listed at
+SURVEY.md §2.2 — ``ConceptCapLoaderTrain/Val``, ``BertForMultiModalPreTraining``
+at reference worker.py:44-46). This package provides the TPU-native training
+counterpart so the framework can fine-tune / reproduce such checkpoints:
+per-task losses over the 10-tuple heads, and a ``pjit``-compiled train step
+over the dp×tp mesh.
+"""
+
+from vilbert_multitask_tpu.train.losses import (
+    LossConfig,
+    grounding_loss,
+    label_bce_loss,
+    masked_lm_loss,
+    masked_region_loss,
+    multitask_loss,
+    retrieval_contrastive_loss,
+    softmax_ce_loss,
+)
+from vilbert_multitask_tpu.train.step import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    shard_train_state,
+)
+
+__all__ = [
+    "LossConfig",
+    "TrainState",
+    "create_train_state",
+    "grounding_loss",
+    "label_bce_loss",
+    "make_train_step",
+    "masked_lm_loss",
+    "masked_region_loss",
+    "multitask_loss",
+    "retrieval_contrastive_loss",
+    "shard_train_state",
+    "softmax_ce_loss",
+]

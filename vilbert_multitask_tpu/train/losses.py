@@ -1,0 +1,157 @@
+"""Per-task losses over the ViLBERT 10-tuple heads.
+
+Loss families mirror the 12-in-1 training regime the served checkpoint came
+from (reference README.md:6, arXiv 1912.02315):
+
+- **labels** (VQA/GQA, ``vil_prediction*``): sigmoid BCE against soft answer
+  scores, summed over the answer vocabulary (the standard VQA soft-target
+  loss), mean over batch;
+- **binary / trinary** (NLVR2 / SNLI-VE): softmax cross-entropy;
+- **grounding** (``vision_logit``): KL between the region softmax and an
+  IoU-derived soft target distribution over regions;
+- **ranking** (``vil_logit``): contrastive cross-entropy over each question's
+  candidate-image group (score the aligned image against distractors);
+- **masked LM / masked region** (``linguisic_prediction`` /
+  ``vision_prediction``): the Conceptual-Captions pretraining objectives the
+  reference imports via ``BertForMultiModalPreTraining`` (worker.py:45).
+
+All reductions are float32 regardless of compute dtype — softmax/log-sum-exp
+in bf16 loses answers with close logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from vilbert_multitask_tpu.models.vilbert import ViLBertOutput
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def label_bce_loss(logits, soft_targets) -> jnp.ndarray:
+    """Soft-target BCE, summed over the label axis (VQA convention)."""
+    logits, t = _f32(logits), _f32(soft_targets)
+    per = optax_sigmoid_bce(logits, t)
+    return per.sum(axis=-1).mean()
+
+
+def optax_sigmoid_bce(logits, targets):
+    # Numerically-stable elementwise BCE-with-logits.
+    return jnp.maximum(logits, 0) - logits * targets + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+
+
+def softmax_ce_loss(logits, labels) -> jnp.ndarray:
+    """Integer-label cross-entropy (NLVR2 binary, SNLI-VE trinary)."""
+    logp = jax.nn.log_softmax(_f32(logits), axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def grounding_loss(vision_logit, target_dist, image_mask) -> jnp.ndarray:
+    """KL(region softmax ‖ IoU soft targets); padded regions masked out."""
+    logits = _f32(vision_logit)[..., 0]  # (B, Nv)
+    logits = jnp.where(image_mask > 0, logits, -1e4)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    t = _f32(target_dist)
+    t = t / jnp.clip(t.sum(axis=-1, keepdims=True), 1e-6)
+    return -(t * logp).sum(axis=-1).mean()
+
+
+def retrieval_contrastive_loss(vil_logit, group_size: int) -> jnp.ndarray:
+    """CE over each question's candidate group; index 0 is the aligned image.
+
+    The engine's repeat-batching (worker.py:278-284 semantics) lays a
+    question's candidates out contiguously, so (B, 1) → (B//K, K).
+    """
+    scores = _f32(vil_logit).reshape(-1, group_size)
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    return -logp[:, 0].mean()
+
+
+def masked_lm_loss(linguisic_prediction, mlm_labels) -> jnp.ndarray:
+    """CE on masked positions; label -1 = not masked (BERT convention).
+
+    With ``task_specific_tokens`` the prediction sequence is one longer than
+    the input (task token inserted after [CLS], models/embeddings.py); labels
+    are realigned by inserting an ignore label at that slot.
+    """
+    if linguisic_prediction.shape[1] == mlm_labels.shape[1] + 1:
+        pad = jnp.full_like(mlm_labels[:, :1], -1)
+        mlm_labels = jnp.concatenate(
+            [mlm_labels[:, :1], pad, mlm_labels[:, 1:]], axis=1)
+    logp = jax.nn.log_softmax(_f32(linguisic_prediction), axis=-1)
+    mask = (mlm_labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(mlm_labels, 0)
+    per = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return (per * mask).sum() / jnp.clip(mask.sum(), 1.0)
+
+
+def masked_region_loss(vision_prediction, target_dist, region_mask) -> jnp.ndarray:
+    """KL vs detector class distribution on masked regions
+    (predict_feature=False path, reference worker.py:510-514)."""
+    logp = jax.nn.log_softmax(_f32(vision_prediction), axis=-1)
+    t = _f32(target_dist)
+    mask = _f32(region_mask)
+    per = -(t * logp).sum(axis=-1)
+    return (per * mask).sum() / jnp.clip(mask.sum(), 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    """Which heads train, with what weight. Static under jit."""
+
+    heads: Sequence[str] = ("vqa",)
+    weights: Tuple[float, ...] = ()
+    retrieval_group_size: int = 2
+
+    def weight_for(self, i: int) -> float:
+        return self.weights[i] if i < len(self.weights) else 1.0
+
+
+def multitask_loss(
+    cfg: LossConfig, out: ViLBertOutput, batch: Dict[str, jnp.ndarray]
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Weighted sum of the configured head losses.
+
+    Batch target keys by head: ``vqa``→``vqa_target`` (B, 3129 soft),
+    ``gqa``→``gqa_target``, ``binary``→``binary_label`` int, ``tri``→
+    ``tri_label`` int, ``grounding``→``grounding_target`` (B, Nv) +
+    ``image_mask``, ``retrieval``→ (uses vil_logit + cfg.retrieval_group_size),
+    ``mlm``→``mlm_labels`` int (-1 pad), ``mrm``→``mrm_target`` (B, Nv, C) +
+    ``mrm_mask`` (B, Nv).
+    """
+    metrics: Dict[str, jnp.ndarray] = {}
+    total = jnp.zeros((), jnp.float32)
+    for i, head in enumerate(cfg.heads):
+        if head == "vqa":
+            l = label_bce_loss(out.vil_prediction, batch["vqa_target"])
+        elif head == "gqa":
+            l = label_bce_loss(out.vil_prediction_gqa, batch["gqa_target"])
+        elif head == "binary":
+            l = softmax_ce_loss(out.vil_binary_prediction, batch["binary_label"])
+        elif head == "tri":
+            l = softmax_ce_loss(out.vil_tri_prediction, batch["tri_label"])
+        elif head == "grounding":
+            l = grounding_loss(out.vision_logit, batch["grounding_target"],
+                               batch["image_mask"])
+        elif head == "retrieval":
+            l = retrieval_contrastive_loss(out.vil_logit,
+                                           cfg.retrieval_group_size)
+        elif head == "mlm":
+            l = masked_lm_loss(out.linguisic_prediction, batch["mlm_labels"])
+        elif head == "mrm":
+            l = masked_region_loss(out.vision_prediction, batch["mrm_target"],
+                                   batch["mrm_mask"])
+        else:
+            raise ValueError(f"unknown loss head {head!r}")
+        metrics[f"loss/{head}"] = l
+        total = total + cfg.weight_for(i) * l
+    metrics["loss/total"] = total
+    return total, metrics

@@ -1,0 +1,133 @@
+"""The sharded train step: one ``jit``-compiled optimizer update on the mesh.
+
+TPU-first design (contrast SURVEY.md §2.3 — the reference has no training and
+no device parallelism): params are placed by the Megatron-style partition
+rules in :mod:`..parallel.sharding`, batches are dp-sharded on axis 0, and
+``jax.jit`` lowers the whole value-grad-update to a single XLA program whose
+collectives (psum over tp for contracting matmuls, grad all-reduce over dp)
+ride ICI. State buffers are donated so the update is in-place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks
+from vilbert_multitask_tpu.parallel import sharding as shd
+from vilbert_multitask_tpu.train.losses import LossConfig, multitask_loss
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: dict
+    opt_state: optax.OptState
+    rng: jax.Array
+
+
+def default_optimizer(
+    learning_rate: float = 4e-5,
+    weight_decay: float = 0.01,
+    warmup_steps: int = 1000,
+    total_steps: int = 100_000,
+    grad_clip: float = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW + linear warmup/decay + global-norm clip (BERT fine-tune recipe)."""
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(sched, weight_decay=weight_decay,
+                    mask=_weight_decay_mask),
+    )
+
+
+def _weight_decay_mask(params):
+    """No decay on biases / LayerNorm scales (standard BERT convention)."""
+
+    def is_decayed(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1]))
+        return leaf.ndim >= 2 and name not in ("bias", "scale")
+
+    return jax.tree_util.tree_map_with_path(is_decayed, params)
+
+
+def create_train_state(
+    params, tx: optax.GradientTransformation, *, seed: int = 0
+) -> TrainState:
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def shard_train_state(state: TrainState, mesh) -> TrainState:
+    """Place params by partition rules; optimizer moments mirror their param's
+    sharding (same shapes → same specs); scalars/rng replicate."""
+    p_shard = shd.param_shardings(state.params, mesh)
+    params = jax.device_put(state.params, p_shard)
+
+    # adamw opt_state nests ScaleByAdamState whose mu/nu are exact param-tree
+    # copies: shard them with the params' own shardings.
+    def place_state(s):
+        if isinstance(s, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(
+                count=jax.device_put(s.count),
+                mu=jax.device_put(s.mu, p_shard),
+                nu=jax.device_put(s.nu, p_shard),
+            )
+        return s
+
+    opt_state = jax.tree_util.tree_map(
+        place_state, state.opt_state,
+        is_leaf=lambda s: isinstance(s, optax.ScaleByAdamState),
+    )
+    return TrainState(
+        step=jax.device_put(state.step),
+        params=params,
+        opt_state=opt_state,
+        rng=jax.device_put(state.rng),
+    )
+
+
+def make_train_step(
+    model: ViLBertForVLTasks,
+    tx: optax.GradientTransformation,
+    loss_cfg: LossConfig,
+    *,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict], Tuple[TrainState, Dict]]:
+    """Build the jitted step. Re-jit per (model, tx, loss_cfg) triple."""
+
+    def step_fn(state: TrainState, batch: Dict) -> Tuple[TrainState, Dict]:
+        rng, dropout_rng = jax.random.split(state.rng)
+
+        def loss_fn(params):
+            out = model.apply(
+                {"params": params},
+                batch["input_ids"], batch["features"], batch["spatials"],
+                batch["segment_ids"], batch["input_mask"],
+                batch["image_mask"], None, batch.get("task_ids"),
+                deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+            return multitask_loss(loss_cfg, out, batch)
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state, rng=rng
+        )
+        return new_state, metrics
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,28 @@
+"""Checkpoint subsystem: torch→native conversion + Orbax store.
+
+Replaces the reference's in-process ``from_pretrained`` torch load
+(reference worker.py:83,530-532) with an offline converter and a shard-aware
+native store (SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from vilbert_multitask_tpu.checkpoint.convert import (
+    build_name_map,
+    convert_torch_state_dict,
+    load_torch_checkpoint,
+    to_torch_state_dict,
+)
+from vilbert_multitask_tpu.checkpoint.store import (
+    convert_and_save,
+    restore_params,
+    save_params,
+)
+
+__all__ = [
+    "build_name_map",
+    "convert_and_save",
+    "convert_torch_state_dict",
+    "load_torch_checkpoint",
+    "restore_params",
+    "save_params",
+    "to_torch_state_dict",
+]

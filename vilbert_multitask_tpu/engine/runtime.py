@@ -83,7 +83,12 @@ class InferenceEngine:
         self.cfg = cfg or FrameworkConfig()
         ecfg = self.cfg.engine
         self.compute_dtype = jnp.dtype(ecfg.compute_dtype)
-        self.model = ViLBertForVLTasks(self.cfg.model, dtype=self.compute_dtype)
+        model_cfg = self.cfg.model
+        if ecfg.use_pallas_coattention != model_cfg.use_pallas_coattention:
+            model_cfg = dataclasses.replace(
+                model_cfg, use_pallas_coattention=ecfg.use_pallas_coattention
+            )
+        self.model = ViLBertForVLTasks(model_cfg, dtype=self.compute_dtype)
         self.tokenizer = tokenizer or FullTokenizer(demo_vocab())
         self.feature_store = feature_store
         self.labels = label_store or LabelMapStore(

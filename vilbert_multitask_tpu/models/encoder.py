@@ -73,6 +73,7 @@ class TwoStreamEncoder(nn.Module):
                 hidden_dropout=cfg.hidden_dropout_prob,
                 attention_dropout=cfg.attention_probs_dropout_prob,
                 layer_norm_eps=cfg.layer_norm_eps,
+                use_pallas=cfg.use_pallas_coattention,
                 dtype=self.dtype,
                 name=f"c_layer_{i}",
             )
@@ -110,6 +111,7 @@ class TwoStreamEncoder(nn.Module):
             v_hidden, t_hidden, co_probs = self.c_layers[c_idx](
                 v_hidden, v_mask_bias, t_hidden, t_mask_bias,
                 deterministic=deterministic,
+                need_probs=collect_attention,
             )
             if collect_attention:
                 attn_maps.append(co_probs)

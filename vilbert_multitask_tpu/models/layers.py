@@ -136,6 +136,7 @@ class ConnectionLayer(nn.Module):
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
+    use_pallas: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -147,23 +148,28 @@ class ConnectionLayer(nn.Module):
         t_mask_bias,  # (B, 1, 1, Nt)
         *,
         deterministic: bool = True,
+        need_probs: bool = True,
     ) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
         # Text queries over image keys/values → feeds the TEXT stream.
         t_ctx, probs_t2v = CrossAttention(
             bi_hidden_size=self.bi_hidden_size,
             num_heads=self.bi_num_heads,
             dropout_rate=self.attention_dropout,
+            use_pallas=self.use_pallas,
             dtype=self.dtype,
             name="text_attends_image",
-        )(t_hidden, v_hidden, v_mask_bias, deterministic=deterministic)
+        )(t_hidden, v_hidden, v_mask_bias, deterministic=deterministic,
+          need_probs=need_probs)
         # Image queries over text keys/values → feeds the IMAGE stream.
         v_ctx, probs_v2t = CrossAttention(
             bi_hidden_size=self.bi_hidden_size,
             num_heads=self.bi_num_heads,
             dropout_rate=self.attention_dropout,
+            use_pallas=self.use_pallas,
             dtype=self.dtype,
             name="image_attends_text",
-        )(v_hidden, t_hidden, t_mask_bias, deterministic=deterministic)
+        )(v_hidden, t_hidden, t_mask_bias, deterministic=deterministic,
+          need_probs=need_probs)
 
         v_hidden = AttentionOutput(
             hidden_size=self.v_hidden_size,

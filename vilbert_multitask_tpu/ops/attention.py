@@ -106,10 +106,12 @@ class CrossAttention(nn.Module):
     bi_hidden_size: int
     num_heads: int
     dropout_rate: float = 0.1
+    use_pallas: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, y, y_mask_bias, *, deterministic: bool = True):
+    def __call__(self, x, y, y_mask_bias, *, deterministic: bool = True,
+                 need_probs: bool = True):
         head_dim = self.bi_hidden_size // self.num_heads
         q = nn.Dense(self.bi_hidden_size, dtype=self.dtype, name="query")(x)
         k = nn.Dense(self.bi_hidden_size, dtype=self.dtype, name="key")(y)
@@ -119,9 +121,15 @@ class CrossAttention(nn.Module):
         q = q.reshape(B, Nq, self.num_heads, head_dim)
         k = k.reshape(B, Nk, self.num_heads, head_dim)
         v = v.reshape(B, Nk, self.num_heads, head_dim)
-        dropout_rng = None
-        if not deterministic and self.dropout_rate > 0.0:
-            dropout_rng = self.make_rng("dropout")
+        use_dropout = not deterministic and self.dropout_rate > 0.0
+        if self.use_pallas and not need_probs and not use_dropout:
+            from vilbert_multitask_tpu.ops.coattention import (
+                flash_cross_attention,
+            )
+
+            ctx = flash_cross_attention(q, k, v, y_mask_bias)
+            return ctx.reshape(B, Nq, self.bi_hidden_size), None
+        dropout_rng = self.make_rng("dropout") if use_dropout else None
         ctx, probs = multi_head_attention(
             q, k, v, y_mask_bias,
             dropout_rate=self.dropout_rate,

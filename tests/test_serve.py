@@ -1,0 +1,312 @@
+"""Serving-tier tests: queue durability/poison handling, store, hub, and the
+submit→enqueue→infer→persist→push path end-to-end with a tiny real engine
+(the service-integration strategy from SURVEY.md §4)."""
+
+import dataclasses
+import json
+import http.client
+import os
+import queue as queue_mod
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.config import (
+    EngineConfig,
+    FrameworkConfig,
+    ServingConfig,
+    ViLBertConfig,
+)
+from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+from vilbert_multitask_tpu.features.store import FeatureStore, save_reference_npy
+from vilbert_multitask_tpu.serve import (
+    ApiServer,
+    DurableQueue,
+    PushHub,
+    ResultStore,
+    ServeWorker,
+    WebSocketBridge,
+    make_job_message,
+)
+
+
+# ------------------------------------------------------------------ fixtures
+@pytest.fixture(scope="module")
+def tiny_framework_cfg(tmp_path_factory):
+    root = tmp_path_factory.mktemp("serve_state")
+    return FrameworkConfig(
+        model=ViLBertConfig().tiny(),
+        engine=EngineConfig(
+            max_text_len=12, max_regions=9, num_features=8,
+            image_buckets=(1, 2), compute_dtype="float32",
+        ),
+        serving=ServingConfig(
+            queue_db_path=str(root / "queue.sqlite3"),
+            results_db_path=str(root / "results.sqlite3"),
+            media_root=str(root / "media"),
+            http_port=0,
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def features_dir(tmp_path_factory, tiny_framework_cfg):
+    d = tmp_path_factory.mktemp("features")
+    rng = np.random.default_rng(0)
+    dim = tiny_framework_cfg.model.v_feature_size
+    for name in ("img_a", "img_b"):
+        boxes = np.array([[10, 10, 60, 60], [30, 20, 90, 80],
+                          [5, 40, 50, 95]], np.float32)
+        region = RegionFeatures(
+            features=rng.normal(size=(3, dim)).astype(np.float32),
+            boxes=boxes, image_width=100, image_height=100)
+        save_reference_npy(str(d / f"{name}.npy"), region, name)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_framework_cfg, features_dir):
+    return InferenceEngine(tiny_framework_cfg,
+                           feature_store=FeatureStore(features_dir))
+
+
+@pytest.fixture()
+def stack(tiny_framework_cfg, engine, tmp_path):
+    s = dataclasses.replace(
+        tiny_framework_cfg.serving,
+        queue_db_path=str(tmp_path / "q.sqlite3"),
+        results_db_path=str(tmp_path / "r.sqlite3"),
+        media_root=str(tmp_path / "media"),
+    )
+    hub = PushHub()
+    q = DurableQueue(s.queue_db_path, max_delivery_attempts=s.max_delivery_attempts)
+    store = ResultStore(s.results_db_path)
+    worker = ServeWorker(engine, q, store, hub, s)
+    return s, hub, q, store, worker
+
+
+# ------------------------------------------------------------------- queue
+def test_queue_durability_and_ack(tmp_path):
+    path = str(tmp_path / "q.sqlite3")
+    q = DurableQueue(path)
+    q.publish({"n": 1})
+    q.publish({"n": 2})
+    # durability: a fresh handle (new "process") sees the jobs
+    q2 = DurableQueue(path)
+    job = q2.claim()
+    assert job.body == {"n": 1} and job.attempts == 1
+    q2.ack(job.id)
+    assert q2.counts() == {"pending": 1}
+
+
+def test_queue_poison_dead_letters(tmp_path):
+    q = DurableQueue(str(tmp_path / "q.sqlite3"), max_delivery_attempts=2)
+    q.publish({"bad": True})
+    assert q.nack(q.claim().id) == "pending"  # attempt 1 → retry
+    assert q.nack(q.claim().id) == "dead"  # attempt 2 → dead-letter
+    assert q.claim() is None
+    assert [j.body for j in q.dead_jobs()] == [{"bad": True}]
+
+
+def test_queue_crash_loop_dead_letters_at_claim(tmp_path):
+    """A job whose worker dies before nack() must still dead-letter once
+    attempts are exhausted (claim-side enforcement)."""
+    q = DurableQueue(str(tmp_path / "q.sqlite3"), max_delivery_attempts=2,
+                     visibility_timeout_s=0.0)
+    q.publish({"crash": True})
+    assert q.claim() is not None  # attempt 1; "worker crashes" (no ack/nack)
+    assert q.claim() is not None  # attempt 2 via expired claim
+    assert q.claim() is None  # attempts exhausted → dead, not redelivered
+    assert [j.body for j in q.dead_jobs()] == [{"crash": True}]
+
+
+def test_queue_visibility_timeout(tmp_path):
+    q = DurableQueue(str(tmp_path / "q.sqlite3"), visibility_timeout_s=0.0)
+    q.publish({"n": 1})
+    first = q.claim()
+    # claim expired immediately → redelivered to the "next worker"
+    second = q.claim()
+    assert second is not None and second.id == first.id
+    assert second.attempts == 2
+
+
+# ------------------------------------------------------------------- store
+def test_result_store_catalog_and_qa(tmp_path):
+    store = ResultStore(str(tmp_path / "r.sqlite3"))
+    tasks = store.list_tasks()
+    assert {t["unique_id"] for t in tasks} == {1, 2, 4, 7, 11, 12, 13, 15, 16}
+    qa_id = store.create_question(1, "what is this", ["img_a.jpg"], "sock1")
+    store.save_answer(qa_id, {"answers": [{"answer": "cat"}]})
+    row = store.get_question(qa_id)
+    assert row["answer_text"]["answers"][0]["answer"] == "cat"
+    assert store.recent()[0]["id"] == qa_id
+
+
+# --------------------------------------------------------------------- hub
+def test_push_hub_groups():
+    hub = PushHub(max_queued=2)
+    q1 = hub.subscribe("s1")
+    q2 = hub.subscribe("s1")
+    other = hub.subscribe("s2")
+    assert hub.publish("s1", {"terminal": "hi"}) == 2
+    assert q1.get_nowait() == {"terminal": "hi"}
+    assert q2.get_nowait() == {"terminal": "hi"}
+    with pytest.raises(queue_mod.Empty):
+        other.get_nowait()
+    # overflow drops oldest, keeps newest
+    hub.publish("s1", {"n": 1})
+    hub.publish("s1", {"n": 2})
+    hub.publish("s1", {"n": 3})
+    assert [q1.get_nowait()["n"] for _ in range(2)] == [2, 3]
+    hub.unsubscribe("s1", q1)
+    assert hub.publish("s1", {"n": 4}) == 1
+
+
+# ------------------------------------------------------------ worker e2e
+def test_worker_end_to_end_vqa(stack):
+    s, hub, q, store, worker = stack
+    sub = hub.subscribe("sockA")
+    q.publish(make_job_message(["img_a.jpg"], "what is this", 1, "sockA"))
+    assert worker.step() == "acked"
+    assert q.counts() == {}
+    frames = []
+    while True:
+        try:
+            frames.append(sub.get_nowait())
+        except queue_mod.Empty:
+            break
+    result_frames = [f for f in frames if "result" in f]
+    assert len(result_frames) == 1
+    res = result_frames[0]["result"]
+    assert res["task_id"] == 1 and len(res["answers"]) == 3
+    row = store.recent()[0]
+    assert row["answer_text"]["answers"] == res["answers"]
+
+
+def test_worker_poison_job_dead_letters(stack):
+    s, hub, q, store, worker = stack
+    before = len(store.recent(100))
+    q.publish(make_job_message(["missing_img.jpg"], "q", 1, "sockB"))
+    outcomes = [worker.step() for _ in range(s.max_delivery_attempts)]
+    assert outcomes[:-1] == ["requeued"] * (s.max_delivery_attempts - 1)
+    assert outcomes[-1] == "dead"
+    assert worker.step() is None  # not redelivered
+    # redelivered attempts reuse one audit row, not one per attempt
+    assert len(store.recent(100)) == before + 1
+
+
+def test_worker_grounding_draws_boxes(stack, tmp_path):
+    from PIL import Image
+
+    s, hub, q, store, worker = stack
+    img_path = str(tmp_path / "img_a.jpg")  # key 'img_a' hits the store
+    Image.new("RGB", (100, 100), (128, 128, 128)).save(img_path)
+    q.publish(make_job_message([img_path], "the left thing", 11, "sockC"))
+    assert worker.step() == "acked"
+    row = store.recent()[0]
+    assert row["task_id"] == 11
+    assert len(row["answer_images"]) == 3
+    assert all(os.path.exists(p) for p in row["answer_images"])
+
+
+def test_worker_nlvr2_and_retrieval(stack):
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg", "img_b.jpg"], "both same", 12,
+                               "sockD"))
+    q.publish(make_job_message(["img_a.jpg", "img_b.jpg"], "a caption", 7,
+                               "sockD"))
+    assert worker.step() == "acked"
+    assert worker.step() == "acked"
+    rows = store.recent(2)
+    kinds = {r["task_id"]: r["answer_text"]["kind"] for r in rows}
+    assert kinds == {12: "binary", 7: "ranking"}
+
+
+# ---------------------------------------------------------------- http api
+def test_http_api_roundtrip(stack):
+    s, hub, q, store, worker = stack
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/")
+        root = json.loads(conn.getresponse().read())
+        assert len(root["tasks"]) == 9 and root["socket_id"]
+
+        conn.request("GET", "/get_task_details/1/")
+        task = json.loads(conn.getresponse().read())
+        assert task["name"] == "VQA"
+
+        body = json.dumps({
+            "task_id": 1, "socket_id": "sockH", "question": "WHAT Is This",
+            "image_list": ["img_a.jpg"],
+        })
+        conn.request("POST", "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = json.loads(conn.getresponse().read())
+        assert resp["task"] == "VQA"
+        job = q.claim()
+        assert job.body["question"] == "what is this"  # lowercased (views.py:27)
+        q.ack(job.id)
+
+        # image-count gating (worker.py:256-263 semantics)
+        conn.request("POST", "/", body=json.dumps({
+            "task_id": 12, "socket_id": "x", "question": "q",
+            "image_list": ["a.jpg"],
+        }), headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+
+        # multipart upload
+        boundary = "XBOUND"
+        part = (f"--{boundary}\r\n"
+                'Content-Disposition: form-data; name="file"; '
+                'filename="pic.jpg"\r\n'
+                "Content-Type: image/jpeg\r\n\r\n").encode() + b"JPGDATA" + \
+            f"\r\n--{boundary}--\r\n".encode()
+        conn.request("POST", "/upload_image/", body=part, headers={
+            "Content-Type": f"multipart/form-data; boundary={boundary}"})
+        up = json.loads(conn.getresponse().read())
+        assert len(up["file_paths"]) == 1
+        assert open(up["file_paths"][0], "rb").read() == b"JPGDATA"
+
+        conn.request("GET", "/healthz")
+        assert json.loads(conn.getresponse().read())["ok"] is True
+
+        # media traversal: absolute and dot-dot paths must be rejected
+        os.makedirs(s.media_root, exist_ok=True)
+        with open(os.path.join(s.media_root, "ok.txt"), "w") as f:
+            f.write("fine")
+        for bad in ("/media//etc/passwd", "/media/../../etc/passwd"):
+            conn.request("GET", bad)
+            assert conn.getresponse().status in (403, 404), bad
+        conn.request("GET", "/media/ok.txt")
+        resp = conn.getresponse()
+        assert resp.status == 200 and resp.read() == b"fine"
+    finally:
+        api.stop()
+
+
+# --------------------------------------------------------------- websocket
+def test_websocket_bridge_delivers(stack):
+    pytest.importorskip("websockets")
+    from websockets.sync.client import connect
+
+    s, hub, q, store, worker = stack
+    bridge = WebSocketBridge(hub, "127.0.0.1", 0)
+    # port 0 → pick free port; websockets.serve supports it, read back below
+    bridge.start()
+    try:
+        with connect(f"ws://127.0.0.1:{bridge.bound_port}/chat/") as ws:
+            ws.send("sockWS")
+            import time
+
+            deadline = time.time() + 5
+            while hub.publish("sockWS", {"info": "hello"}) == 0:
+                if time.time() > deadline:
+                    pytest.fail("subscriber never registered")
+                time.sleep(0.02)
+            frame = json.loads(ws.recv(timeout=5))
+            assert frame == {"info": "hello"}
+    finally:
+        bridge.stop()

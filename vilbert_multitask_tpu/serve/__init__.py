@@ -1,0 +1,27 @@
+"""Serving tier: durable queue, result store, push hub, HTTP API, worker.
+
+The TPU-native rebuild of the reference's L3-L6 stack (SURVEY.md §1):
+Django+RabbitMQ+Redis+Postgres collapse into an embedded, broker-less stack
+with the same wire contracts (queue message schema, websocket frame keys,
+HTTP endpoints).
+"""
+
+from vilbert_multitask_tpu.serve.db import ResultStore
+from vilbert_multitask_tpu.serve.http_api import ApiServer
+from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge, log_to_terminal
+from vilbert_multitask_tpu.serve.queue import DurableQueue, Job, make_job_message
+from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
+from vilbert_multitask_tpu.serve.worker import ServeWorker
+
+__all__ = [
+    "ApiServer",
+    "DurableQueue",
+    "Job",
+    "PushHub",
+    "ResultStore",
+    "ServeWorker",
+    "WebSocketBridge",
+    "draw_grounding_boxes",
+    "log_to_terminal",
+    "make_job_message",
+]

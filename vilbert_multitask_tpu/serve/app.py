@@ -1,0 +1,102 @@
+"""Application composition: engine + queue + worker + HTTP + websocket.
+
+Reference capability: the deployment described by SURVEY.md §1 — Django
+(wsgi/asgi), a RabbitMQ broker, Redis, Postgres, and a GPU worker process —
+collapsed into one self-contained serving binary per host: the TPU engine and
+all tiers share the process; durability lives in the sqlite queue/store
+files. ``python -m vilbert_multitask_tpu.serve.app`` boots everything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from typing import Optional
+
+from vilbert_multitask_tpu.config import FrameworkConfig
+from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+from vilbert_multitask_tpu.features.store import FeatureStore
+from vilbert_multitask_tpu.serve.db import ResultStore
+from vilbert_multitask_tpu.serve.http_api import ApiServer
+from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge
+from vilbert_multitask_tpu.serve.queue import DurableQueue
+from vilbert_multitask_tpu.serve.worker import ServeWorker
+
+
+class ServeApp:
+    def __init__(self, cfg: Optional[FrameworkConfig] = None, *,
+                 engine: Optional[InferenceEngine] = None,
+                 feature_root: str = "features",
+                 checkpoint_path: Optional[str] = None):
+        self.cfg = cfg or FrameworkConfig()
+        s = self.cfg.serving
+        self.hub = PushHub()
+        self.queue = DurableQueue(
+            s.queue_db_path, queue_name=s.queue_name,
+            max_delivery_attempts=s.max_delivery_attempts)
+        self.store = ResultStore(s.results_db_path)
+        if engine is None:
+            params = None
+            if checkpoint_path is not None:
+                from vilbert_multitask_tpu.checkpoint import restore_params
+
+                params = restore_params(checkpoint_path)
+            engine = InferenceEngine(
+                self.cfg, params=params,
+                feature_store=FeatureStore(feature_root))
+        self.engine = engine
+        self.worker = ServeWorker(self.engine, self.queue, self.store,
+                                  self.hub, s)
+        self.api = ApiServer(self.queue, self.store, self.hub, s)
+        self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
+        self.http_port: Optional[int] = None  # actual bound port after start
+        self._stop = threading.Event()
+        self._worker_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self.http_port = self.api.start()
+        self.ws.start()
+        self._worker_thread = threading.Thread(
+            target=self.worker.run_forever,
+            kwargs={"stop_event": self._stop},
+            daemon=True, name="serve-worker")
+        self._worker_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._worker_thread:
+            self._worker_thread.join(timeout=10)
+        self.api.stop()
+        self.ws.stop()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="ViLBERT multi-task TPU server")
+    p.add_argument("--features", default="features",
+                   help="precomputed region-feature directory (.npy/.vlfr)")
+    p.add_argument("--checkpoint", default=None,
+                   help="Orbax checkpoint dir (from checkpoint.convert_and_"
+                        "save); omitting it serves RANDOM weights")
+    p.add_argument("--warmup", action="store_true",
+                   help="pre-compile all shape buckets before accepting jobs")
+    args = p.parse_args(argv)
+
+    app = ServeApp(feature_root=args.features,
+                   checkpoint_path=args.checkpoint)
+    if args.checkpoint is None:
+        print("WARNING: no --checkpoint given; serving randomly initialized "
+              "weights (answers will be meaningless)")
+    if args.warmup:
+        app.engine.warmup()
+    app.start()
+    s = app.cfg.serving
+    print(f"http://{s.http_host}:{app.http_port}  "
+          f"ws://{s.http_host}:{app.ws.bound_port}  queue={s.queue_db_path}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        app.stop()
+
+
+if __name__ == "__main__":
+    main()

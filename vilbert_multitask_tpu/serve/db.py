@@ -1,0 +1,161 @@
+"""Result store: the ORM layer's capability on embedded sqlite.
+
+Reference capability: the Django models (reference demo/models.py:4-46) on
+PostgreSQL — ``Tasks`` (the task catalog the UI reads) and ``QuestionAnswer``
+(the de-facto audit log: every job writes inputs at creation and answers on
+completion, reference worker.py:548-552,579-645) — plus the admin's read path
+(demo/admin.py:24-34). Credentials-in-repo (settings.py:85-94, SURVEY.md §2.4)
+are gone: the store is a file next to the queue.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from vilbert_multitask_tpu.config import TASK_REGISTRY
+
+
+class ResultStore:
+    def __init__(self, path: str):
+        self.path = path
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        with self._conn() as c:
+            c.execute(
+                """CREATE TABLE IF NOT EXISTS tasks (
+                    unique_id INTEGER PRIMARY KEY,
+                    name TEXT NOT NULL,
+                    placeholder TEXT,
+                    description TEXT,
+                    num_of_images INTEGER NOT NULL
+                )"""
+            )
+            c.execute(
+                """CREATE TABLE IF NOT EXISTS question_answers (
+                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    task_id INTEGER NOT NULL,
+                    input_text TEXT,
+                    input_images TEXT,
+                    answer_text TEXT,
+                    answer_images TEXT,
+                    socket_id TEXT,
+                    queue_job_id INTEGER,
+                    created_at REAL NOT NULL,
+                    modified_at REAL NOT NULL
+                )"""
+            )
+            c.execute(
+                "CREATE UNIQUE INDEX IF NOT EXISTS qa_by_job ON "
+                "question_answers (queue_job_id) WHERE queue_job_id IS NOT NULL"
+            )
+            # Seed the task catalog from the typed registry (replaces the
+            # reference's hand-entered admin rows, demo/models.py:4-20).
+            for spec in TASK_REGISTRY.values():
+                c.execute(
+                    "INSERT OR IGNORE INTO tasks "
+                    "(unique_id, name, placeholder, description, num_of_images)"
+                    " VALUES (?, ?, ?, ?, ?)",
+                    (spec.task_id, spec.name, spec.placeholder,
+                     spec.description, spec.max_images),
+                )
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.path, timeout=30.0)
+        conn.execute("PRAGMA journal_mode=WAL")
+        return conn
+
+    # ------------------------------------------------------------------ tasks
+    def get_task(self, task_id: int) -> Optional[Dict[str, Any]]:
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT unique_id, name, placeholder, description, "
+                "num_of_images FROM tasks WHERE unique_id=?",
+                (task_id,),
+            ).fetchone()
+        if row is None:
+            return None
+        return dict(zip(
+            ("unique_id", "name", "placeholder", "description",
+             "num_of_images"), row))
+
+    def list_tasks(self) -> List[Dict[str, Any]]:
+        cols = ("unique_id", "name", "placeholder", "description",
+                "num_of_images")
+        with self._conn() as c:
+            rows = c.execute(
+                f"SELECT {', '.join(cols)} FROM tasks ORDER BY unique_id"
+            ).fetchall()
+        return [dict(zip(cols, r)) for r in rows]
+
+    # --------------------------------------------------------------- QA rows
+    def create_question(self, task_id: int, input_text: str,
+                        input_images: List[str], socket_id: str,
+                        queue_job_id: Optional[int] = None) -> int:
+        """Job intake row (reference worker.py:548-552).
+
+        When ``queue_job_id`` is given, redelivered attempts of the same
+        queued job reuse the original row instead of inserting duplicates.
+        """
+        now = time.time()
+        with self._conn() as c:
+            if queue_job_id is not None:
+                row = c.execute(
+                    "SELECT id FROM question_answers WHERE queue_job_id=?",
+                    (queue_job_id,),
+                ).fetchone()
+                if row is not None:
+                    return int(row[0])
+            cur = c.execute(
+                "INSERT INTO question_answers (task_id, input_text, "
+                "input_images, socket_id, queue_job_id, created_at, "
+                "modified_at) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (task_id, input_text, json.dumps(list(input_images)),
+                 socket_id, queue_job_id, now, now),
+            )
+            return int(cur.lastrowid)
+
+    def save_answer(self, qa_id: int, answer: Dict[str, Any],
+                    answer_images: Optional[List[str]] = None) -> None:
+        """Completion update (reference worker.py:579,606,623,644)."""
+        with self._conn() as c:
+            c.execute(
+                "UPDATE question_answers SET answer_text=?, answer_images=?, "
+                "modified_at=? WHERE id=?",
+                (json.dumps(answer), json.dumps(answer_images or []),
+                 time.time(), qa_id),
+            )
+
+    _QA_COLS = ("id", "task_id", "input_text", "input_images", "answer_text",
+                "answer_images", "socket_id", "created_at", "modified_at")
+
+    @classmethod
+    def _qa_row(cls, row) -> Dict[str, Any]:
+        d = dict(zip(cls._QA_COLS, row))
+        for k in ("input_images", "answer_text", "answer_images"):
+            if d[k]:
+                d[k] = json.loads(d[k])
+        return d
+
+    def get_question(self, qa_id: int) -> Optional[Dict[str, Any]]:
+        with self._conn() as c:
+            row = c.execute(
+                f"SELECT {', '.join(self._QA_COLS)} FROM question_answers "
+                "WHERE id=?",
+                (qa_id,),
+            ).fetchone()
+        return None if row is None else self._qa_row(row)
+
+    def recent(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Latest jobs, newest first (the admin list view's read,
+        demo/admin.py:24-34)."""
+        with self._conn() as c:
+            rows = c.execute(
+                f"SELECT {', '.join(self._QA_COLS)} FROM question_answers "
+                "ORDER BY id DESC LIMIT ?",
+                (limit,),
+            ).fetchall()
+        return [self._qa_row(r) for r in rows]

@@ -1,0 +1,218 @@
+"""HTTP tier: job submission, task metadata, uploads, media.
+
+Reference capability: the Django views + URL map (reference demo/urls.py:7-11,
+demo/views.py):
+
+- ``POST /``                      submit a job {socket_id, task_id, question,
+                                  image_list[]} → enqueue (views.py:19-42)
+- ``GET  /get_task_details/<id>/`` task metadata JSON (views.py:45-61)
+- ``GET  /get_demo_images/``       random sample of demo images (views.py:64-81)
+- ``POST /upload_image/``          multipart upload, uuid-renamed into media
+                                   (views.py:84-106) → {"file_paths": [...]}
+- ``GET  /media/...``              media serving (vilbert_multitask/urls.py:27-31)
+
+Redesign: stdlib ``ThreadingHTTPServer`` + JSON bodies (the browser-facing
+HTML shell is not part of the framework contract; the API is). Submission
+returns the queued job id — the answer itself still arrives over the
+websocket, preserving the reference's fire-and-forget shape (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import email
+import email.policy
+import json
+import mimetypes
+import os
+import random
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from vilbert_multitask_tpu.config import ServingConfig, TASK_REGISTRY
+from vilbert_multitask_tpu.serve.db import ResultStore
+from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
+from vilbert_multitask_tpu.serve.queue import DurableQueue, make_job_message
+
+
+class ApiServer:
+    def __init__(
+        self,
+        queue: DurableQueue,
+        store: ResultStore,
+        hub: PushHub,
+        serving: Optional[ServingConfig] = None,
+    ):
+        self.queue = queue
+        self.store = store
+        self.hub = hub
+        self.serving = serving or ServingConfig()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- handlers
+    def submit_job(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        try:
+            task_id = int(payload["task_id"])
+            socket_id = str(payload.get("socket_id", ""))
+            question = str(payload.get("question", ""))
+            images = list(payload.get("image_list", []))
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "need task_id, socket_id, question, image_list"}
+        spec = TASK_REGISTRY.get(task_id)
+        if spec is None:
+            return 400, {"error": f"unknown task_id {task_id}"}
+        try:
+            spec.validate_num_images(len(images))
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        if self.serving.lowercase_questions:
+            question = question.lower()  # reference views.py:27
+        log_to_terminal(self.hub, socket_id,
+                        {"info": f"Starting {spec.name} job..."})
+        job_id = self.queue.publish(
+            make_job_message(images, question, task_id, socket_id))
+        return 200, {"job_id": job_id, "task": spec.name}
+
+    def task_details(self, task_id: int) -> Tuple[int, Dict[str, Any]]:
+        task = self.store.get_task(task_id)
+        if task is None:
+            return 404, {"error": f"unknown task {task_id}"}
+        return 200, task
+
+    def demo_images(self, count: int = 8) -> Tuple[int, Dict[str, Any]]:
+        demo_dir = os.path.join(self.serving.media_root, "demo")
+        files = []
+        if os.path.isdir(demo_dir):
+            files = [
+                os.path.join(demo_dir, f) for f in sorted(os.listdir(demo_dir))
+                if f.lower().endswith((".jpg", ".jpeg", ".png"))
+            ]
+        if len(files) > count:
+            files = random.sample(files, count)
+        return 200, {"demo_images": files}
+
+    def save_upload(self, filename: str, data: bytes) -> str:
+        """uuid-rename into media/demo (reference views.py:84-103)."""
+        ext = os.path.splitext(filename)[1].lower() or ".jpg"
+        out_dir = os.path.join(self.serving.media_root, "demo")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"{uuid.uuid4()}{ext}")
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    # --------------------------------------------------------------- server
+    def _make_handler(self):
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _json(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/") or "/"
+                if path == "/":
+                    self._json(200, {
+                        "tasks": api.store.list_tasks(),
+                        "socket_id": str(uuid.uuid4()),
+                    })
+                elif path.startswith("/get_task_details/"):
+                    try:
+                        task_id = int(path.split("/")[2])
+                    except (IndexError, ValueError):
+                        self._json(400, {"error": "bad task id"})
+                        return
+                    self._json(*api.task_details(task_id))
+                elif path == "/get_demo_images":
+                    self._json(*api.demo_images())
+                elif self.path.startswith("/media/"):
+                    self._serve_media()
+                elif path == "/healthz":
+                    self._json(200, {"ok": True, "queue": api.queue.counts()})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def _serve_media(self):
+                rel = self.path[len("/media/"):].lstrip("/")
+                root = os.path.realpath(api.serving.media_root)
+                full = os.path.realpath(os.path.join(root, rel))
+                # containment check: resolved target must stay under media_root
+                if os.path.commonpath([root, full]) != root:
+                    self._json(403, {"error": "forbidden"})
+                    return
+                if not os.path.isfile(full):
+                    self._json(404, {"error": "not found"})
+                    return
+                ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+                with open(full, "rb") as f:
+                    data = f.read()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                ctype = self.headers.get("Content-Type", "")
+                path = self.path.rstrip("/") or "/"
+                if path == "/":
+                    try:
+                        payload = json.loads(raw or b"{}")
+                    except json.JSONDecodeError:
+                        self._json(400, {"error": "invalid JSON"})
+                        return
+                    self._json(*api.submit_job(payload))
+                elif path == "/upload_image":
+                    self._handle_upload(raw, ctype)
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def _handle_upload(self, raw: bytes, ctype: str):
+                if "multipart/form-data" not in ctype:
+                    self._json(400, {"error": "expected multipart/form-data"})
+                    return
+                msg = email.message_from_bytes(
+                    b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + raw,
+                    policy=email.policy.HTTP,
+                )
+                paths = []
+                for part in msg.iter_parts():
+                    name = part.get_filename()
+                    if not name:
+                        continue
+                    if len(paths) >= api.serving.max_upload_images:
+                        break  # reference caps uploads (demo_images.html:92-95)
+                    paths.append(api.save_upload(
+                        name, part.get_payload(decode=True) or b""))
+                self._json(200, {"file_paths": paths})
+
+        return Handler
+
+    def start(self) -> int:
+        self._httpd = ThreadingHTTPServer(
+            (self.serving.http_host, self.serving.http_port),
+            self._make_handler(),
+        )
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="http-api")
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,168 @@
+"""Durable job queue (sqlite-backed), wire-compatible with the reference.
+
+Reference capability: the RabbitMQ layer — producer ``vilbert_task``
+(reference demo/sender.py:10-35: durable queue ``vilbert_multitask_queue``,
+persistent JSON messages ``{image_path, question, socket_id, task_id}``) and
+the worker's blocking consume + ack (worker.py:661-673,650).
+
+Redesign, not translation: a broker daemon is replaced by an embedded
+WAL-mode sqlite file, which keeps the reference's durability guarantees
+(jobs survive process death; unacked jobs are redelivered) while fixing the
+poison-message loop the reference has (worker.py:650-655 — a job that always
+throws is redelivered forever): delivery attempts are counted and jobs move
+to a dead-letter state after ``max_delivery_attempts``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class Job:
+    id: int
+    body: Dict[str, Any]
+    attempts: int
+
+
+class DurableQueue:
+    """Embedded durable queue with at-least-once delivery + dead-lettering."""
+
+    def __init__(self, path: str, *, queue_name: str = "vilbert_multitask_queue",
+                 max_delivery_attempts: int = 3,
+                 visibility_timeout_s: float = 300.0):
+        self.path = path
+        self.queue_name = queue_name
+        self.max_delivery_attempts = max_delivery_attempts
+        self.visibility_timeout_s = visibility_timeout_s
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        with self._conn() as c:
+            c.execute(
+                """CREATE TABLE IF NOT EXISTS jobs (
+                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    queue TEXT NOT NULL,
+                    body TEXT NOT NULL,
+                    status TEXT NOT NULL DEFAULT 'pending',
+                    attempts INTEGER NOT NULL DEFAULT 0,
+                    claimed_at REAL,
+                    created_at REAL NOT NULL
+                )"""
+            )
+            c.execute("CREATE INDEX IF NOT EXISTS jobs_ready "
+                      "ON jobs (queue, status, id)")
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.path, timeout=30.0)
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        return conn
+
+    # ---------------------------------------------------------------- producer
+    def publish(self, body: Dict[str, Any]) -> int:
+        """Persist one job (the reference's delivery_mode=2, sender.py:30-31)."""
+        with self._conn() as c:
+            cur = c.execute(
+                "INSERT INTO jobs (queue, body, created_at) VALUES (?, ?, ?)",
+                (self.queue_name, json.dumps(body), time.time()),
+            )
+            return int(cur.lastrowid)
+
+    # ---------------------------------------------------------------- consumer
+    def claim(self) -> Optional[Job]:
+        """Atomically claim the oldest deliverable job (None if drained).
+
+        Also sweeps expired in-flight claims back to pending — the embedded
+        equivalent of a broker's visibility timeout, covering worker crashes
+        between claim and ack (reference relies on connection-drop redelivery,
+        worker.py:653-655).
+        """
+        now = time.time()
+        with self._conn() as c:
+            c.execute("BEGIN IMMEDIATE")
+            c.execute(
+                "UPDATE jobs SET status='pending', claimed_at=NULL "
+                "WHERE queue=? AND status='inflight' AND claimed_at < ?",
+                (self.queue_name, now - self.visibility_timeout_s),
+            )
+            # Jobs that crash the whole worker never reach nack(); without
+            # this, a timed-out claim would redeliver them forever.
+            c.execute(
+                "UPDATE jobs SET status='dead', claimed_at=NULL "
+                "WHERE queue=? AND status='pending' AND attempts >= ?",
+                (self.queue_name, self.max_delivery_attempts),
+            )
+            row = c.execute(
+                "SELECT id, body, attempts FROM jobs "
+                "WHERE queue=? AND status='pending' ORDER BY id LIMIT 1",
+                (self.queue_name,),
+            ).fetchone()
+            if row is None:
+                return None
+            job_id, body, attempts = row
+            c.execute(
+                "UPDATE jobs SET status='inflight', attempts=attempts+1, "
+                "claimed_at=? WHERE id=?",
+                (now, job_id),
+            )
+            return Job(id=job_id, body=json.loads(body), attempts=attempts + 1)
+
+    def ack(self, job_id: int) -> None:
+        """Success: remove the job (reference basic_ack, worker.py:650)."""
+        with self._conn() as c:
+            c.execute("DELETE FROM jobs WHERE id=?", (job_id,))
+
+    def nack(self, job_id: int) -> str:
+        """Failure: requeue, or dead-letter once attempts are exhausted.
+
+        Returns the resulting status ('pending' or 'dead').
+        """
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT attempts FROM jobs WHERE id=?", (job_id,)
+            ).fetchone()
+            if row is None:
+                return "gone"
+            status = ("dead" if row[0] >= self.max_delivery_attempts
+                      else "pending")
+            c.execute(
+                "UPDATE jobs SET status=?, claimed_at=NULL WHERE id=?",
+                (status, job_id),
+            )
+            return status
+
+    # ------------------------------------------------------------------ introspection
+    def counts(self) -> Dict[str, int]:
+        with self._conn() as c:
+            rows = c.execute(
+                "SELECT status, COUNT(*) FROM jobs WHERE queue=? "
+                "GROUP BY status",
+                (self.queue_name,),
+            ).fetchall()
+        return {status: n for status, n in rows}
+
+    def dead_jobs(self) -> list[Job]:
+        with self._conn() as c:
+            rows = c.execute(
+                "SELECT id, body, attempts FROM jobs "
+                "WHERE queue=? AND status='dead' ORDER BY id",
+                (self.queue_name,),
+            ).fetchall()
+        return [Job(i, json.loads(b), a) for i, b, a in rows]
+
+
+def make_job_message(image_paths, question: str, task_id: int,
+                     socket_id: str) -> Dict[str, Any]:
+    """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
+    list of absolute paths, ``question`` the (pre-lowercased) query."""
+    return {
+        "image_path": list(image_paths),
+        "question": question,
+        "task_id": str(task_id),  # reference sends str; worker eval()s it
+        "socket_id": socket_id,
+    }

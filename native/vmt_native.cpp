@@ -1,0 +1,175 @@
+// Native runtime components for the vilbert_multitask_tpu framework.
+//
+// Reference capability: the C++/CUDA layer the reference leans on through
+// `maskrcnn_benchmark` — the NMS kernel (reference worker.py:51,147) and the
+// per-class box-selection loop it powers (worker.py:123-176) — plus a fast
+// loader for the packed .vlfr region-feature files (features/store.py). The
+// TPU serving path reads precomputed features, so these run host-side in the
+// offline extractor and data plane, exactly where the reference's native
+// code ran.
+//
+// Exported as a plain C ABI for ctypes (no pybind11 in the image).
+// Semantics are kept bit-identical to the JAX implementations in
+// vilbert_multitask_tpu/ops/nms.py, which the tests enforce.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Greedy NMS, torchvision/maskrcnn semantics (ops/nms.py:nms_mask): visit
+// boxes in descending score order (ties: lower index first — matching the
+// stable argsort in the JAX path); keep a box iff IoU <= threshold against
+// every already-kept box. Writes a 0/1 mask; returns the number kept.
+int vmt_nms(const float* boxes, const float* scores, int n,
+            float iou_threshold, uint8_t* keep_out) {
+  std::vector<int> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int a, int b) { return scores[a] > scores[b]; });
+
+  std::vector<float> area(n);
+  for (int i = 0; i < n; ++i) {
+    const float* b = boxes + 4 * i;
+    area[i] = (b[2] - b[0]) * (b[3] - b[1]);
+    keep_out[i] = 0;
+  }
+
+  std::vector<int> kept;
+  kept.reserve(n);
+  for (int oi = 0; oi < n; ++oi) {
+    int i = order[oi];
+    const float* bi = boxes + 4 * i;
+    bool suppressed = false;
+    for (int j : kept) {
+      const float* bj = boxes + 4 * j;
+      float lx = std::max(bi[0], bj[0]);
+      float ly = std::max(bi[1], bj[1]);
+      float rx = std::min(bi[2], bj[2]);
+      float ry = std::min(bi[3], bj[3]);
+      float w = std::max(0.0f, rx - lx);
+      float h = std::max(0.0f, ry - ly);
+      float inter = w * h;
+      float uni = area[i] + area[j] - inter;
+      float iou = uni > 0.0f ? inter / uni : 0.0f;
+      if (iou > iou_threshold) {
+        suppressed = true;
+        break;
+      }
+    }
+    if (!suppressed) {
+      kept.push_back(i);
+      keep_out[i] = 1;
+    }
+  }
+  return static_cast<int>(kept.size());
+}
+
+// Per-class NMS → per-box max surviving confidence → top-num_keep selection
+// (ops/nms.py:select_top_regions; reference loop worker.py:136-163).
+// class_scores is (n, c) row-major, column 0 = background when
+// background == 0. Outputs:
+//   keep_indices (num_keep)  — top boxes by max_conf, conf desc / index asc
+//   max_conf     (n)
+//   objects      (num_keep)  — class argmax over non-background columns
+//   cls_prob     (num_keep)  — that argmax's score
+// Returns num_valid (kept boxes with conf > 0).
+int vmt_select_top_regions(const float* boxes, const float* class_scores,
+                           int n, int c, int num_keep, float iou_threshold,
+                           float conf_threshold, int background,
+                           int32_t* keep_indices, float* max_conf,
+                           int32_t* objects, float* cls_prob) {
+  const int start = background ? 0 : 1;
+  std::vector<float> col(n);
+  std::vector<uint8_t> keep(n);
+  for (int i = 0; i < n; ++i) max_conf[i] = 0.0f;
+
+  for (int cls = start; cls < c; ++cls) {
+    for (int i = 0; i < n; ++i) col[i] = class_scores[i * c + cls];
+    vmt_nms(boxes, col.data(), n, iou_threshold, keep.data());
+    for (int i = 0; i < n; ++i) {
+      if (keep[i] && col[i] > conf_threshold && col[i] > max_conf[i])
+        max_conf[i] = col[i];
+    }
+  }
+
+  std::vector<int> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
+    return max_conf[a] > max_conf[b];
+  });
+
+  int num_valid = 0;
+  for (int k = 0; k < num_keep; ++k) {
+    int idx = k < n ? order[k] : 0;
+    keep_indices[k] = idx;
+    if (k < n && max_conf[idx] > 0.0f) ++num_valid;
+    const float* row = class_scores + idx * c + start;
+    int arg = 0;
+    float best = row[0];
+    for (int j = 1; j < c - start; ++j) {
+      if (row[j] > best) {
+        best = row[j];
+        arg = j;
+      }
+    }
+    objects[k] = arg;
+    cls_prob[k] = best;
+  }
+  return num_valid;
+}
+
+// ---------------------------------------------------------------- .vlfr IO
+// Packed region-feature format (features/store.py): magic "VLFR\x01",
+// then u32 {n, d, w, h}, then f32 features[n*d], f32 boxes[n*4].
+
+static const char kVlfrMagic[5] = {'V', 'L', 'F', 'R', '\x01'};
+
+// Reads the header; returns 0 on success, negative errno-style codes.
+int vmt_vlfr_header(const char* path, int32_t* n, int32_t* d, int32_t* w,
+                    int32_t* h) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[5];
+  uint32_t hdr[4];
+  if (std::fread(magic, 1, 5, f) != 5 ||
+      std::memcmp(magic, kVlfrMagic, 5) != 0 ||
+      std::fread(hdr, 4, 4, f) != 4) {
+    std::fclose(f);
+    return -2;
+  }
+  *n = static_cast<int32_t>(hdr[0]);
+  *d = static_cast<int32_t>(hdr[1]);
+  *w = static_cast<int32_t>(hdr[2]);
+  *h = static_cast<int32_t>(hdr[3]);
+  std::fclose(f);
+  return 0;
+}
+
+// Reads the payload into caller-allocated buffers (sized from the header).
+int vmt_vlfr_read(const char* path, float* features, float* boxes) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[5];
+  uint32_t hdr[4];
+  if (std::fread(magic, 1, 5, f) != 5 ||
+      std::memcmp(magic, kVlfrMagic, 5) != 0 ||
+      std::fread(hdr, 4, 4, f) != 4) {
+    std::fclose(f);
+    return -2;
+  }
+  size_t n = hdr[0], d = hdr[1];
+  if (std::fread(features, 4, n * d, f) != n * d ||
+      std::fread(boxes, 4, n * 4, f) != n * 4) {
+    std::fclose(f);
+    return -3;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""Native C++ components vs their JAX/numpy twins (bit-level parity)."""
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu import native
+from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+from vilbert_multitask_tpu.features.store import load_vlfr, save_vlfr
+from vilbert_multitask_tpu.ops import nms as jnms
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+def _random_boxes(rng, n, size=200.0):
+    x1 = rng.random((n,)) * size
+    y1 = rng.random((n,)) * size
+    w = rng.random((n,)) * size / 2 + 1
+    h = rng.random((n,)) * size / 2 + 1
+    return np.stack([x1, y1, x1 + w, y1 + h], axis=1).astype(np.float32)
+
+
+def test_nms_matches_jax():
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        n = 60
+        boxes = _random_boxes(rng, n)
+        scores = rng.random((n,)).astype(np.float32)
+        ours = native.nms(boxes, scores, 0.5)
+        ref = np.asarray(jnms.nms_mask(boxes, scores, iou_threshold=0.5))
+        np.testing.assert_array_equal(ours, ref, err_msg=f"trial {trial}")
+
+
+def test_nms_tie_handling():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.9, 0.1], np.float32)  # exact tie
+    ours = native.nms(boxes, scores, 0.3)
+    ref = np.asarray(jnms.nms_mask(boxes, scores, iou_threshold=0.3))
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_select_top_regions_matches_jax():
+    rng = np.random.default_rng(1)
+    n, c, k = 40, 7, 10
+    boxes = _random_boxes(rng, n)
+    raw = rng.random((n, c)).astype(np.float32)
+    scores = raw / raw.sum(axis=1, keepdims=True)
+    keep_n, valid_n, conf_n, obj_n, prob_n = native.select_top_regions(
+        boxes, scores, num_keep=k, iou_threshold=0.5)
+    keep_j, valid_j, conf_j, obj_j, prob_j = (
+        np.asarray(x) for x in jnms.select_top_regions(
+            boxes, scores, num_keep=k, iou_threshold=0.5))
+    np.testing.assert_allclose(conf_n, conf_j, atol=1e-6)
+    np.testing.assert_array_equal(keep_n, keep_j)
+    assert valid_n == valid_j
+    np.testing.assert_array_equal(obj_n, obj_j)
+    np.testing.assert_allclose(prob_n, prob_j, atol=1e-6)
+
+
+def test_vlfr_reader_matches_python(tmp_path):
+    rng = np.random.default_rng(2)
+    region = RegionFeatures(
+        features=rng.normal(size=(17, 64)).astype(np.float32),
+        boxes=_random_boxes(rng, 17),
+        image_width=320, image_height=240)
+    path = str(tmp_path / "x.vlfr")
+    save_vlfr(path, region)
+    a = load_vlfr(path)
+    b = native.read_vlfr(path)
+    np.testing.assert_array_equal(a.features, b.features)
+    np.testing.assert_array_equal(a.boxes, b.boxes)
+    assert (a.image_width, a.image_height, a.num_boxes) == (
+        b.image_width, b.image_height, b.num_boxes)
+
+
+def test_vlfr_reader_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.vlfr")
+    with open(path, "wb") as f:
+        f.write(b"NOTAVLFRFILE")
+    with pytest.raises(IOError):
+        native.read_vlfr(path)

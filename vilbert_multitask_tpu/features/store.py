@@ -100,6 +100,21 @@ class FeatureStore:
         self.root = root
         self.max_cached = max_cached
         self._cache: "OrderedDict[str, RegionFeatures]" = OrderedDict()
+        # Probe (and if needed build) the native reader at construction —
+        # boot-time cost, so the first request never pays the g++ build —
+        # but only when this store actually holds .vlfr files.
+        self._native_ok = False
+        if self._has_vlfr():
+            from vilbert_multitask_tpu import native
+
+            self._native_ok = native.available()
+
+    def _has_vlfr(self) -> bool:
+        try:
+            with os.scandir(self.root) as it:
+                return any(e.name.endswith(".vlfr") for e in it)
+        except OSError:
+            return False
 
     def path_for(self, key: str) -> str:
         for ext, loader in ((".npy", load_reference_npy), (".vlfr", load_vlfr)):
@@ -116,9 +131,14 @@ class FeatureStore:
             self._cache.move_to_end(key)
             return self._cache[key]
         path = self.path_for(key)
-        region = (
-            load_reference_npy(path) if path.endswith(".npy") else load_vlfr(path)
-        )
+        if path.endswith(".npy"):
+            region = load_reference_npy(path)
+        elif self._native_ok:
+            from vilbert_multitask_tpu import native
+
+            region = native.read_vlfr(path)
+        else:
+            region = load_vlfr(path)
         self._cache[key] = region
         if len(self._cache) > self.max_cached:
             self._cache.popitem(last=False)

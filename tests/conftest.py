@@ -34,3 +34,83 @@ def tiny_config():
 @pytest.fixture(scope="session")
 def rng():
     return jax.random.PRNGKey(0)
+
+
+# --------------------------------------------------------- serving fixtures
+@pytest.fixture(scope="session")
+def tiny_framework_cfg(tmp_path_factory):
+    from vilbert_multitask_tpu.config import (
+        EngineConfig,
+        FrameworkConfig,
+        ServingConfig,
+        ViLBertConfig,
+    )
+
+    root = tmp_path_factory.mktemp("serve_state")
+    return FrameworkConfig(
+        model=ViLBertConfig().tiny(),
+        engine=EngineConfig(
+            max_text_len=12, max_regions=9, num_features=8,
+            image_buckets=(1, 2, 4, 8), compute_dtype="float32",
+        ),
+        serving=ServingConfig(
+            queue_db_path=str(root / "queue.sqlite3"),
+            results_db_path=str(root / "results.sqlite3"),
+            media_root=str(root / "media"),
+            http_port=0,
+        ),
+    )
+
+
+@pytest.fixture(scope="session")
+def features_dir(tmp_path_factory, tiny_framework_cfg):
+    import numpy as np
+
+    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.store import save_reference_npy
+
+    d = tmp_path_factory.mktemp("features")
+    nrng = np.random.default_rng(0)
+    dim = tiny_framework_cfg.model.v_feature_size
+    for name in ("img_a", "img_b"):
+        boxes = np.array([[10, 10, 60, 60], [30, 20, 90, 80],
+                          [5, 40, 50, 95]], np.float32)
+        region = RegionFeatures(
+            features=nrng.normal(size=(3, dim)).astype(np.float32),
+            boxes=boxes, image_width=100, image_height=100)
+        save_reference_npy(str(d / f"{name}.npy"), region, name)
+    return str(d)
+
+
+@pytest.fixture(scope="session")
+def engine(tiny_framework_cfg, features_dir):
+    from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+    from vilbert_multitask_tpu.features.store import FeatureStore
+
+    return InferenceEngine(tiny_framework_cfg,
+                           feature_store=FeatureStore(features_dir))
+
+
+@pytest.fixture()
+def stack(tiny_framework_cfg, engine, tmp_path):
+    import dataclasses
+
+    from vilbert_multitask_tpu.serve import (
+        DurableQueue,
+        PushHub,
+        ResultStore,
+        ServeWorker,
+    )
+
+    s = dataclasses.replace(
+        tiny_framework_cfg.serving,
+        queue_db_path=str(tmp_path / "q.sqlite3"),
+        results_db_path=str(tmp_path / "r.sqlite3"),
+        media_root=str(tmp_path / "media"),
+    )
+    hub = PushHub()
+    q = DurableQueue(s.queue_db_path,
+                     max_delivery_attempts=s.max_delivery_attempts)
+    store = ResultStore(s.results_db_path)
+    worker = ServeWorker(engine, q, store, hub, s)
+    return s, hub, q, store, worker

@@ -1,0 +1,104 @@
+"""Cross-task micro-batching: one forward serving a mixed-task batch."""
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.serve import make_job_message
+
+
+def _prep(engine, task_id, question, keys):
+    regions = engine.feature_store.get_batch(keys)
+    return engine.prepare(task_id, question, regions, keys)
+
+
+def test_run_many_matches_individual_runs(engine):
+    reqs = [
+        _prep(engine, 1, "what is this", ["img_a.jpg"]),
+        _prep(engine, 15, "is it red", ["img_b.jpg"]),
+        _prep(engine, 13, "a dog plays", ["img_a.jpg"]),
+        _prep(engine, 11, "the left box", ["img_b.jpg"]),
+    ]
+    batched = engine.run_many(reqs)
+    assert [r.kind for r in batched] == ["labels", "labels", "trinary",
+                                        "grounding"]
+    for req, got in zip(reqs, batched):
+        _, solo = engine.run(req)
+        if got.answers is not None:
+            assert [a["answer"] for a in got.answers] == \
+                [a["answer"] for a in solo.answers]
+            np.testing.assert_allclose(
+                [a["confidence"] for a in got.answers],
+                [a["confidence"] for a in solo.answers], atol=1e-4)
+        if got.boxes is not None:
+            assert [b["region_index"] for b in got.boxes] == \
+                [b["region_index"] for b in solo.boxes]
+
+
+def test_run_many_rejects_multi_image(engine):
+    req = _prep(engine, 12, "both", ["img_a.jpg", "img_b.jpg"])
+    with pytest.raises(ValueError, match="single-image"):
+        engine.run_many([req])
+
+
+def test_run_many_empty(engine):
+    assert engine.run_many([]) == []
+
+
+def test_run_many_chunks_beyond_max_bucket(engine):
+    """Batches above the largest compiled bucket split, not crash."""
+    max_bucket = max(engine.cfg.engine.image_buckets)
+    n = max_bucket + 3
+    reqs = [
+        _prep(engine, 1, f"question {i}", [("img_a.jpg", "img_b.jpg")[i % 2]])
+        for i in range(n)
+    ]
+    results = engine.run_many(reqs)
+    assert len(results) == n
+    assert all(r.kind == "labels" for r in results)
+
+
+def test_prepare_clips_oversized_feature_files(engine):
+    """Feature files with more boxes than the engine's region budget clip to
+    the top-N (files are confidence-ordered) instead of erroring."""
+    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+
+    max_regions = engine.cfg.engine.max_regions
+    n = max_regions + 20
+    rng = np.random.default_rng(5)
+    region = RegionFeatures(
+        features=rng.normal(
+            size=(n, engine.cfg.model.v_feature_size)).astype(np.float32),
+        boxes=np.tile(np.array([[1, 1, 50, 50]], np.float32), (n, 1)),
+        image_width=100, image_height=100)
+    req = engine.prepare(1, "what", [region])
+    assert req.features.shape[1] == max_regions
+    assert int(req.image_mask[0].sum()) == max_regions  # global + N-1 boxes
+    _, result = engine.run(req)
+    assert result.kind == "labels"
+
+
+def test_worker_step_batch_mixed_tasks(stack):
+    s, hub, q, store, worker = stack
+    before = len(store.recent(100))
+    q.publish(make_job_message(["img_a.jpg"], "what", 1, "m1"))
+    q.publish(make_job_message(["img_b.jpg"], "where", 15, "m2"))
+    q.publish(make_job_message(["img_a.jpg", "img_b.jpg"], "both", 12, "m3"))
+    q.publish(make_job_message(["img_b.jpg"], "entails", 13, "m4"))
+    assert worker.step_batch(max_jobs=8) == 4
+    assert q.counts() == {}
+    rows = store.recent(100)
+    assert len(rows) == before + 4
+    by_task = {r["task_id"]: r for r in rows[:4]}
+    assert by_task[12]["answer_text"]["kind"] == "binary"
+    assert by_task[1]["answer_text"]["kind"] == "labels"
+
+
+def test_worker_step_batch_poison_isolated(stack):
+    """One bad job in a batch must not poison its batchmates."""
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "ok", 1, "p1"))
+    q.publish(make_job_message(["no_such_key.jpg"], "bad", 1, "p2"))
+    q.publish(make_job_message(["img_b.jpg"], "ok2", 15, "p3"))
+    assert worker.step_batch(max_jobs=8) == 2
+    counts = q.counts()
+    assert counts.get("pending") == 1  # poison requeued, good ones gone

@@ -1,0 +1,99 @@
+"""Eval-harness tests: metric definitions against hand-computed values, and
+the engine-driven evaluator over synthetic JSONL fixtures."""
+
+import json
+
+import pytest
+
+from vilbert_multitask_tpu.evals import (
+    Evaluator,
+    box_iou_single,
+    grounding_hit,
+    load_jsonl,
+    retrieval_recall_at_k,
+    vqa_soft_accuracy,
+)
+
+
+# ------------------------------------------------------------------ metrics
+def test_vqa_soft_accuracy_closed_form():
+    answers = ["cat"] * 10
+    assert vqa_soft_accuracy("cat", answers) == 1.0
+    assert vqa_soft_accuracy("dog", answers) == 0.0
+    # 3 of 10 say "cat": leave-one-out → 7 subsets with 3 matches (acc 1.0)
+    # and 3 subsets with 2 matches (acc 2/3) → (7 + 3*2/3)/10 = 0.9
+    answers = ["cat"] * 3 + ["dog"] * 7
+    assert vqa_soft_accuracy("cat", answers) == pytest.approx(0.9)
+    assert vqa_soft_accuracy("CAT ", answers) == pytest.approx(0.9)  # norm
+    # single-answer sets (GQA-style): exact match
+    assert vqa_soft_accuracy("yes", ["yes"]) == 1.0
+    assert vqa_soft_accuracy("no", ["yes"]) == 0.0
+
+
+def test_box_iou_and_hit():
+    assert box_iou_single([0, 0, 10, 10], [0, 0, 10, 10]) == 1.0
+    assert box_iou_single([0, 0, 10, 10], [20, 20, 30, 30]) == 0.0
+    # half overlap: inter 50, union 150 → 1/3
+    assert box_iou_single([0, 0, 10, 10], [5, 0, 15, 10]) == pytest.approx(1 / 3)
+    assert grounding_hit([0, 0, 10, 10], [1, 1, 10, 10])
+    assert not grounding_hit([0, 0, 10, 10], [5, 0, 15, 10])
+
+
+def test_recall_at_k():
+    assert retrieval_recall_at_k(1, 1)
+    assert not retrieval_recall_at_k(2, 1)
+    assert retrieval_recall_at_k(5, 5)
+
+
+# ----------------------------------------------------------------- harness
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def test_evaluator_vqa_and_grounding(engine, tmp_path):
+    ev = Evaluator(engine, batch=4)
+    vqa = _write_jsonl(tmp_path / "vqa.jsonl", [
+        {"question": "what is it", "image": "img_a.jpg",
+         "answers": ["label_0"] * 10},
+        {"question": "what color", "image": "img_b.jpg",
+         "answers": ["label_1"] * 10},
+    ])
+    out = ev.run("vqa", load_jsonl(vqa))
+    assert out["n"] == 2 and 0.0 <= out["accuracy"] <= 1.0
+
+    grd = _write_jsonl(tmp_path / "g.jsonl", [
+        {"expression": "the left box", "image": "img_a.jpg",
+         "gt_box": [10, 10, 60, 60]},
+        {"expression": "the whole image", "image": "img_b.jpg",
+         "gt_box": [0, 0, 100, 100]},
+    ])
+    out = ev.run("grounding", load_jsonl(grd))
+    assert out["n"] == 2 and 0.0 <= out["accuracy"] <= 1.0
+
+
+def test_evaluator_retrieval_and_nlvr2(engine, tmp_path):
+    ev = Evaluator(engine)
+    ret = _write_jsonl(tmp_path / "r.jsonl", [
+        {"caption": "a scene", "images": ["img_a.jpg", "img_b.jpg"],
+         "target": 0},
+        {"caption": "another", "images": ["img_b.jpg", "img_a.jpg"],
+         "target": 1},
+    ])
+    out = ev.run("retrieval", load_jsonl(ret))
+    assert out["n"] == 2
+    assert 0.0 <= out["R@1"] <= out["R@5"] <= out["R@10"] <= 1.0
+
+    nlvr = _write_jsonl(tmp_path / "n.jsonl", [
+        {"caption": "both same", "images": ["img_a.jpg", "img_b.jpg"],
+         "label": True},
+    ])
+    out = ev.run("nlvr2", load_jsonl(nlvr))
+    assert out["n"] == 1 and out["accuracy"] in (0.0, 1.0)
+
+
+def test_evaluator_unknown_task(engine):
+    with pytest.raises(ValueError, match="unknown eval task"):
+        Evaluator(engine).run("pose-estimation", [])

@@ -1,0 +1,105 @@
+"""Offline extractor CLI + multi-host init helper tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.features.extract import (
+    extract_one,
+    main as extract_main,
+    preprocess_image,
+)
+from vilbert_multitask_tpu.features.store import (
+    load_reference_npy,
+    load_vlfr,
+)
+from vilbert_multitask_tpu.parallel import distributed
+
+
+def _raw_dump(tmp_path, name, n=30, c=6, d=32, w=200, h=150, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.random((n,)) * (w - 40)
+    y1 = rng.random((n,)) * (h - 40)
+    boxes = np.stack([x1, y1, x1 + 20 + rng.random(n) * 20,
+                      y1 + 20 + rng.random(n) * 20], axis=1).astype(np.float32)
+    scores = rng.random((n, c)).astype(np.float32)
+    scores /= scores.sum(axis=1, keepdims=True)
+    path = str(tmp_path / f"{name}.npz")
+    np.savez(path, boxes=boxes, cls_scores=scores,
+             features=rng.normal(size=(n, d)).astype(np.float32),
+             image_width=w, image_height=h)
+    return path
+
+
+def test_extract_one_npy_schema(tmp_path):
+    raw = _raw_dump(tmp_path, "img_x")
+    out = extract_one(raw, str(tmp_path / "feats"), fmt="npy", num_keep=10)
+    assert out.endswith("img_x.npy")
+    region = load_reference_npy(out)
+    assert region.features.shape[0] == region.num_boxes <= 10
+    assert region.boxes.shape == (region.num_boxes, 4)
+    assert (region.image_width, region.image_height) == (200, 150)
+
+
+def test_extract_cli_vlfr_glob(tmp_path):
+    for i in range(3):
+        _raw_dump(tmp_path, f"img_{i}", seed=i)
+    out_dir = str(tmp_path / "feats")
+    extract_main(["--raw", str(tmp_path), "--out", out_dir,
+                  "--format", "vlfr", "--num-keep", "5"])
+    files = sorted(os.listdir(out_dir))
+    assert files == ["img_0.vlfr", "img_1.vlfr", "img_2.vlfr"]
+    region = load_vlfr(os.path.join(out_dir, "img_0.vlfr"))
+    assert region.num_boxes <= 5
+
+
+def test_extract_selection_matches_jax_path(tmp_path):
+    """CLI output boxes = the JAX select_top_regions keep set (ordering and
+    membership), regardless of which backend (C++/JAX) actually ran."""
+    from vilbert_multitask_tpu.ops import nms as jnms
+
+    raw_path = _raw_dump(tmp_path, "img_p", seed=3)
+    raw = np.load(raw_path)
+    keep, valid, *_ = (np.asarray(x) for x in jnms.select_top_regions(
+        raw["boxes"], raw["cls_scores"], num_keep=8))
+    out = extract_one(raw_path, str(tmp_path / "f"), fmt="npy", num_keep=8)
+    region = load_reference_npy(out)
+    np.testing.assert_array_equal(
+        region.boxes, raw["boxes"][keep[: int(valid)]])
+
+
+def test_preprocess_image_contract():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (300, 400, 3), np.uint8)
+    out, scale = preprocess_image(img)
+    # short side 300 → target 800 would put long side at 1067 ≤ 1333
+    assert scale == pytest.approx(800 / 300)
+    assert out.shape == (800, 1067, 3)
+    # BGR flip + mean subtraction: channel 0 is original channel 2 minus mean
+    assert out.dtype == np.float32
+    img2 = rng.integers(0, 255, (200, 2000, 3), np.uint8)
+    _, scale2 = preprocess_image(img2)
+    assert scale2 == pytest.approx(1333 / 2000)  # long-side clamp
+
+
+# ------------------------------------------------------------- distributed
+def test_distributed_single_process_fallback(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.initialize() is False  # no coordinator → no-op
+
+
+def test_distributed_requires_full_args(monkeypatch):
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="num_processes"):
+        distributed.initialize(coordinator_address="host:1234")
+
+
+def test_runtime_info_shape():
+    info = distributed.runtime_info()
+    assert info["process_count"] == 1
+    assert info["global_device_count"] >= 1
+    assert info["backend"] == "cpu"

@@ -2,24 +2,13 @@
 submit→enqueue→infer→persist→push path end-to-end with a tiny real engine
 (the service-integration strategy from SURVEY.md §4)."""
 
-import dataclasses
 import json
 import http.client
 import os
 import queue as queue_mod
 
-import numpy as np
 import pytest
 
-from vilbert_multitask_tpu.config import (
-    EngineConfig,
-    FrameworkConfig,
-    ServingConfig,
-    ViLBertConfig,
-)
-from vilbert_multitask_tpu.engine.runtime import InferenceEngine
-from vilbert_multitask_tpu.features.pipeline import RegionFeatures
-from vilbert_multitask_tpu.features.store import FeatureStore, save_reference_npy
 from vilbert_multitask_tpu.serve import (
     ApiServer,
     DurableQueue,
@@ -31,59 +20,8 @@ from vilbert_multitask_tpu.serve import (
 )
 
 
-# ------------------------------------------------------------------ fixtures
-@pytest.fixture(scope="module")
-def tiny_framework_cfg(tmp_path_factory):
-    root = tmp_path_factory.mktemp("serve_state")
-    return FrameworkConfig(
-        model=ViLBertConfig().tiny(),
-        engine=EngineConfig(
-            max_text_len=12, max_regions=9, num_features=8,
-            image_buckets=(1, 2), compute_dtype="float32",
-        ),
-        serving=ServingConfig(
-            queue_db_path=str(root / "queue.sqlite3"),
-            results_db_path=str(root / "results.sqlite3"),
-            media_root=str(root / "media"),
-            http_port=0,
-        ),
-    )
-
-
-@pytest.fixture(scope="module")
-def features_dir(tmp_path_factory, tiny_framework_cfg):
-    d = tmp_path_factory.mktemp("features")
-    rng = np.random.default_rng(0)
-    dim = tiny_framework_cfg.model.v_feature_size
-    for name in ("img_a", "img_b"):
-        boxes = np.array([[10, 10, 60, 60], [30, 20, 90, 80],
-                          [5, 40, 50, 95]], np.float32)
-        region = RegionFeatures(
-            features=rng.normal(size=(3, dim)).astype(np.float32),
-            boxes=boxes, image_width=100, image_height=100)
-        save_reference_npy(str(d / f"{name}.npy"), region, name)
-    return str(d)
-
-
-@pytest.fixture(scope="module")
-def engine(tiny_framework_cfg, features_dir):
-    return InferenceEngine(tiny_framework_cfg,
-                           feature_store=FeatureStore(features_dir))
-
-
-@pytest.fixture()
-def stack(tiny_framework_cfg, engine, tmp_path):
-    s = dataclasses.replace(
-        tiny_framework_cfg.serving,
-        queue_db_path=str(tmp_path / "q.sqlite3"),
-        results_db_path=str(tmp_path / "r.sqlite3"),
-        media_root=str(tmp_path / "media"),
-    )
-    hub = PushHub()
-    q = DurableQueue(s.queue_db_path, max_delivery_attempts=s.max_delivery_attempts)
-    store = ResultStore(s.results_db_path)
-    worker = ServeWorker(engine, q, store, hub, s)
-    return s, hub, q, store, worker
+# fixtures (tiny_framework_cfg / features_dir / engine / stack) live in
+# tests/conftest.py so the batching/eval tests share them.
 
 
 # ------------------------------------------------------------------- queue
@@ -119,6 +57,17 @@ def test_queue_crash_loop_dead_letters_at_claim(tmp_path):
     assert q.claim() is not None  # attempt 2 via expired claim
     assert q.claim() is None  # attempts exhausted → dead, not redelivered
     assert [j.body for j in q.dead_jobs()] == [{"crash": True}]
+
+
+def test_queue_claim_exclude_and_release(tmp_path):
+    q = DurableQueue(str(tmp_path / "q.sqlite3"))
+    a = q.publish({"n": "a"})
+    q.publish({"n": "b"})
+    job = q.claim(exclude=[a])
+    assert job.body == {"n": "b"}
+    q.release(job.id)  # un-claim without charging the attempt
+    again = q.claim(exclude=[a])
+    assert again.id == job.id and again.attempts == 1
 
 
 def test_queue_visibility_timeout(tmp_path):
@@ -221,6 +170,27 @@ def test_worker_nlvr2_and_retrieval(stack):
     rows = store.recent(2)
     kinds = {r["task_id"]: r["answer_text"]["kind"] for r in rows}
     assert kinds == {12: "binary", 7: "ranking"}
+
+
+def test_metrics_recorded_and_served(stack):
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "what", 1, "mm"))
+    q.publish(make_job_message(["nope.jpg"], "bad", 1, "mm"))
+    worker.step_batch()
+    snap = worker.metrics.snapshot()
+    assert snap["requests"] == 1 and snap["by_task"] == {"1": 1}
+    assert snap["failures"] == {"1": 1}
+    assert snap["latency_ms"]["p50"] is not None
+
+    api = ApiServer(q, store, hub, s, metrics=worker.metrics)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics")
+        m = json.loads(conn.getresponse().read())
+        assert m["requests"] == 1 and "queue" in m
+    finally:
+        api.stop()
 
 
 # ---------------------------------------------------------------- http api

@@ -1,0 +1,26 @@
+"""Evaluation harness: the score-parity metrics from BASELINE.md.
+
+The reference repo publishes no evaluation code (scores live in the 12-in-1
+paper, reference README.md:6); the driver's BASELINE.json nevertheless sets
+score parity — VQAv2 accuracy, image-retrieval R@1, RefCOCO accuracy — as an
+acceptance metric. This package provides the harness: dataset readers
+(simple JSONL schemas), the standard metric definitions, and a batched
+engine-driven evaluator with a CLI.
+"""
+
+from vilbert_multitask_tpu.evals.metrics import (
+    box_iou_single,
+    grounding_hit,
+    retrieval_recall_at_k,
+    vqa_soft_accuracy,
+)
+from vilbert_multitask_tpu.evals.harness import Evaluator, load_jsonl
+
+__all__ = [
+    "Evaluator",
+    "box_iou_single",
+    "grounding_hit",
+    "load_jsonl",
+    "retrieval_recall_at_k",
+    "vqa_soft_accuracy",
+]

@@ -1,0 +1,169 @@
+"""Engine-driven evaluator over JSONL datasets.
+
+JSONL schemas (one example per line):
+
+- vqa / gqa:    {"question": str, "image": key, "answers": [str, ...]}
+                (``answers`` = the 10 annotator strings; a single-element
+                list works for exact-match sets like GQA)
+- grounding:    {"expression": str, "image": key, "gt_box": [x1,y1,x2,y2]}
+                (pixel coords in the original image)
+- retrieval:    {"caption": str, "images": [key, ...], "target": 0-based idx}
+- nlvr2:        {"caption": str, "images": [key1, key2], "label": true|false}
+
+Image keys resolve through the engine's FeatureStore (basename-sans-extension
+keys, features/store.py). VQA/GQA/grounding examples run through
+``engine.run_many`` in bucket-sized micro-batches — the same packed path
+serving uses — so evaluation measures the production code path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Any, Dict, Iterable, List
+
+from vilbert_multitask_tpu.evals import metrics as M
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class Evaluator:
+    def __init__(self, engine, *, batch: int = 8):
+        self.engine = engine
+        self.batch = batch
+
+    # ------------------------------------------------------------ per-task
+    def _run_single_image(self, task_id: int, questions: List[str],
+                          images: List[str]):
+        """Micro-batched single-image forward for a (question, image) list."""
+        results = []
+        store = self.engine.feature_store
+        for i in range(0, len(questions), self.batch):
+            reqs = []
+            for q, img in zip(questions[i : i + self.batch],
+                              images[i : i + self.batch]):
+                regions = store.get_batch([img])
+                reqs.append(self.engine.prepare(task_id, q, regions, [img]))
+            results.extend(self.engine.run_many(reqs))
+        return results
+
+    def eval_vqa(self, examples: Iterable[Dict], task_id: int = 1) -> Dict:
+        examples = list(examples)
+        results = self._run_single_image(
+            task_id, [e["question"] for e in examples],
+            [e["image"] for e in examples])
+        accs = [
+            M.vqa_soft_accuracy(r.answers[0]["answer"], e["answers"])
+            for e, r in zip(examples, results)
+        ]
+        return {"metric": "vqa_accuracy", "task_id": task_id,
+                "n": len(accs), "accuracy": sum(accs) / max(len(accs), 1)}
+
+    def eval_grounding(self, examples: Iterable[Dict],
+                       task_id: int = 11) -> Dict:
+        examples = list(examples)
+        results = self._run_single_image(
+            task_id, [e["expression"] for e in examples],
+            [e["image"] for e in examples])
+        hits = [
+            M.grounding_hit(r.boxes[0]["box_xyxy"], e["gt_box"])
+            for e, r in zip(examples, results)
+        ]
+        return {"metric": "grounding_acc@0.5", "task_id": task_id,
+                "n": len(hits), "accuracy": sum(hits) / max(len(hits), 1)}
+
+    def eval_retrieval(self, examples: Iterable[Dict],
+                       task_id: int = 7) -> Dict:
+        store = self.engine.feature_store
+        r1 = r5 = r10 = 0
+        examples = list(examples)
+        for e in examples:
+            keys = e["images"]
+            regions = store.get_batch(keys)
+            req = self.engine.prepare(task_id, e["caption"], regions, keys)
+            _, result = self.engine.run(req)
+            target_key = keys[e["target"]]
+            rank = next(r["rank"] for r in result.ranking
+                        if r["image"] == target_key)
+            r1 += M.retrieval_recall_at_k(rank, 1)
+            r5 += M.retrieval_recall_at_k(rank, 5)
+            r10 += M.retrieval_recall_at_k(rank, 10)
+        n = max(len(examples), 1)
+        return {"metric": "retrieval_recall", "task_id": task_id,
+                "n": len(examples), "R@1": r1 / n, "R@5": r5 / n,
+                "R@10": r10 / n}
+
+    def eval_nlvr2(self, examples: Iterable[Dict], task_id: int = 12) -> Dict:
+        store = self.engine.feature_store
+        correct = 0
+        examples = list(examples)
+        for e in examples:
+            regions = store.get_batch(e["images"])
+            req = self.engine.prepare(task_id, e["caption"], regions,
+                                      e["images"])
+            _, result = self.engine.run(req)
+            pred = result.answers[0]["answer"] == "True"
+            correct += pred == bool(e["label"])
+        n = max(len(examples), 1)
+        return {"metric": "nlvr2_accuracy", "task_id": task_id,
+                "n": len(examples), "accuracy": correct / n}
+
+    # ---------------------------------------------------------------- entry
+    EVAL_FNS = {
+        "vqa": ("eval_vqa", 1),
+        "gqa": ("eval_vqa", 15),
+        "grounding": ("eval_grounding", 11),
+        "visual7w": ("eval_grounding", 4),
+        "retrieval": ("eval_retrieval", 7),
+        "nlvr2": ("eval_nlvr2", 12),
+    }
+
+    def run(self, task: str, examples: Iterable[Dict]) -> Dict:
+        if task not in self.EVAL_FNS:
+            raise ValueError(f"unknown eval task {task!r}; "
+                             f"one of {sorted(self.EVAL_FNS)}")
+        fn_name, task_id = self.EVAL_FNS[task]
+        t0 = time.perf_counter()
+        out = getattr(self, fn_name)(examples, task_id=task_id)
+        out["wall_s"] = round(time.perf_counter() - t0, 3)
+        return out
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="score-parity evaluation")
+    p.add_argument("--task", required=True,
+                   choices=sorted(Evaluator.EVAL_FNS))
+    p.add_argument("--data", required=True, help="JSONL examples")
+    p.add_argument("--features", required=True,
+                   help="precomputed feature dir")
+    p.add_argument("--checkpoint", default=None, help="Orbax params dir")
+    p.add_argument("--batch", type=int, default=8)
+    args = p.parse_args(argv)
+
+    from vilbert_multitask_tpu.config import FrameworkConfig
+    from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+    from vilbert_multitask_tpu.features.store import FeatureStore
+
+    params = None
+    if args.checkpoint:
+        from vilbert_multitask_tpu.checkpoint import restore_params
+
+        params = restore_params(args.checkpoint)
+    engine = InferenceEngine(FrameworkConfig(), params=params,
+                             feature_store=FeatureStore(args.features))
+    result = Evaluator(engine, batch=args.batch).run(
+        args.task, load_jsonl(args.data))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

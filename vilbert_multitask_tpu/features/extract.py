@@ -1,0 +1,137 @@
+"""Offline region-feature extractor: raw detector dumps → feature stores.
+
+Reference capability: the serving-path Faster R-CNN feature extraction
+(reference worker.py:59-223). Per BASELINE.json, serving reads precomputed
+features; this CLI is the offline half that produces them, reproducing the
+reference's post-processing exactly (SURVEY.md §7 hard part (b)):
+
+- image preprocessing contract (worker.py:91-121): RGB→BGR channel order,
+  per-channel mean subtraction, scale so the short side targets 800 px
+  without the long side passing 1333 (helper :func:`preprocess_image`, for
+  wiring an actual detector);
+- per-class NMS@0.5 over the ~1601 class scores + top-100 selection by max
+  surviving confidence (worker.py:123-176) — via the native C++ path when
+  built, else the vectorized JAX path (ops/nms.py);
+- output in the reference ``.npy`` dict schema (worker.py:209-216) or the
+  packed ``.vlfr`` format.
+
+Input: one ``.npz`` per image with arrays ``boxes (N,4)`` (pixel xyxy),
+``cls_scores (N,C)`` (softmaxed, column 0 = background), ``features (N,D)``
+(fc6), and scalars ``image_width``, ``image_height`` — the tensors any
+detector (torch, JAX, or a saved dump) can emit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+from typing import Tuple
+
+import numpy as np
+
+from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+from vilbert_multitask_tpu.features.store import save_reference_npy, save_vlfr
+
+# Per-channel BGR means the reference subtracts (maskrcnn PIXEL_MEAN
+# convention driven from worker.py:102-107).
+BGR_PIXEL_MEANS = np.array([102.9801, 115.9465, 122.7717], np.float32)
+
+
+def preprocess_image(
+    image: np.ndarray,  # (H, W, 3) RGB uint8
+    min_size: int = 800,
+    max_size: int = 1333,
+) -> Tuple[np.ndarray, float]:
+    """RGB image → (BGR float32 mean-subtracted resized, scale).
+
+    Matches the reference's transform semantics (worker.py:91-121): BGR
+    channel flip, mean subtraction, short-side 800 scaling clamped so the
+    long side stays ≤ 1333. Uses PIL bilinear resize.
+    """
+    from PIL import Image
+
+    h, w = image.shape[:2]
+    scale = min_size / min(h, w)
+    if max(h, w) * scale > max_size:
+        scale = max_size / max(h, w)
+    new_w, new_h = int(round(w * scale)), int(round(h * scale))
+    resized = np.asarray(
+        Image.fromarray(image).resize((new_w, new_h), Image.BILINEAR),
+        np.float32,
+    )
+    bgr = resized[:, :, ::-1] - BGR_PIXEL_MEANS
+    return bgr, scale
+
+
+def select_regions(boxes: np.ndarray, cls_scores: np.ndarray,
+                   num_keep: int = 100, iou_threshold: float = 0.5):
+    """Native C++ selection when built, JAX otherwise; identical semantics."""
+    from vilbert_multitask_tpu import native
+
+    if native.available():
+        return native.select_top_regions(
+            boxes, cls_scores, num_keep=num_keep, iou_threshold=iou_threshold)
+    from vilbert_multitask_tpu.ops import nms as jnms
+
+    return tuple(
+        np.asarray(x) for x in jnms.select_top_regions(
+            boxes, cls_scores, num_keep=num_keep, iou_threshold=iou_threshold)
+    )
+
+
+def extract_one(raw_path: str, out_dir: str, fmt: str = "npy",
+                num_keep: int = 100, iou_threshold: float = 0.5) -> str:
+    """One ``.npz`` detector dump → one feature file. Returns the out path."""
+    raw = np.load(raw_path)
+    boxes = np.asarray(raw["boxes"], np.float32)
+    cls_scores = np.asarray(raw["cls_scores"], np.float32)
+    features = np.asarray(raw["features"], np.float32)
+    w, h = int(raw["image_width"]), int(raw["image_height"])
+
+    keep, num_valid, _conf, objects, cls_prob = select_regions(
+        boxes, cls_scores, num_keep=num_keep, iou_threshold=iou_threshold)
+    n = int(min(num_valid, len(keep))) or 1  # at least one region
+    keep = np.asarray(keep[:n])
+    region = RegionFeatures(
+        features=features[keep], boxes=boxes[keep],
+        image_width=w, image_height=h, num_boxes=n)
+
+    key = os.path.splitext(os.path.basename(raw_path))[0]
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, f"{key}.{fmt}")
+    if fmt == "npy":
+        save_reference_npy(out_path, region, key,
+                           objects=np.asarray(objects[:n]),
+                           cls_prob=np.asarray(cls_prob[:n]))
+    elif fmt == "vlfr":
+        save_vlfr(out_path, region)
+    else:
+        raise ValueError(f"unknown format {fmt!r} (npy|vlfr)")
+    return out_path
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="offline feature extraction")
+    p.add_argument("--raw", required=True,
+                   help="detector-dump .npz file, directory, or glob")
+    p.add_argument("--out", required=True, help="output feature directory")
+    p.add_argument("--format", default="npy", choices=("npy", "vlfr"))
+    p.add_argument("--num-keep", type=int, default=100)
+    p.add_argument("--iou-threshold", type=float, default=0.5)
+    args = p.parse_args(argv)
+
+    if os.path.isdir(args.raw):
+        paths = sorted(glob.glob(os.path.join(args.raw, "*.npz")))
+    elif any(ch in args.raw for ch in "*?["):
+        paths = sorted(glob.glob(args.raw))
+    else:
+        paths = [args.raw]
+    for path in paths:
+        out = extract_one(path, args.out, args.format,
+                          args.num_keep, args.iou_threshold)
+        print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Multi-host initialization and the cross-host serving topology.
+
+Reference capability check (SURVEY.md §2.3): the reference has no tensor
+transport at all — its "distributed fabric" is RabbitMQ + Redis + Postgres.
+The TPU-native design keeps that boundary: **ICI carries tensors, DCN
+carries jobs.**
+
+- Within a slice, one process per host joins a single JAX runtime via
+  :func:`initialize`; ``jax.devices()`` then spans the slice and the
+  dp×tp mesh (parallel/mesh.py) lays over all chips, with XLA collectives
+  riding ICI.
+- Across slices/regions, hosts stay independent serving replicas: the
+  durable queue (serve/queue.py) is the only cross-host channel, mirroring
+  the reference's queue boundary (demo/sender.py:26-31 → worker.py:672) —
+  no tensor ever crosses DCN, so there is no custom transport to maintain.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join (or skip) the multi-host JAX runtime.
+
+    Arguments fall back to the standard env vars (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``), which TPU pod launchers set.
+    Returns True when distributed init ran, False for the single-process
+    fallback (no coordinator configured) — so one binary serves dev boxes
+    and pods alike.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+
+    if coordinator_address is None:
+        return False
+    if num_processes is None or process_id is None:
+        raise ValueError(
+            "multi-host init needs num_processes and process_id alongside "
+            "coordinator_address (or JAX_NUM_PROCESSES / JAX_PROCESS_ID)")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def runtime_info() -> dict:
+    """Process/device topology summary (for /healthz and logs)."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "backend": jax.default_backend(),
+    }

@@ -8,6 +8,7 @@ HTTP endpoints).
 
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.http_api import ApiServer
+from vilbert_multitask_tpu.serve.metrics import Metrics
 from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, Job, make_job_message
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
@@ -17,6 +18,7 @@ __all__ = [
     "ApiServer",
     "DurableQueue",
     "Job",
+    "Metrics",
     "PushHub",
     "ResultStore",
     "ServeWorker",

@@ -47,7 +47,8 @@ class ServeApp:
         self.engine = engine
         self.worker = ServeWorker(self.engine, self.queue, self.store,
                                   self.hub, s)
-        self.api = ApiServer(self.queue, self.store, self.hub, s)
+        self.api = ApiServer(self.queue, self.store, self.hub, s,
+                             metrics=self.worker.metrics)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()

@@ -43,11 +43,13 @@ class ApiServer:
         store: ResultStore,
         hub: PushHub,
         serving: Optional[ServingConfig] = None,
+        metrics=None,
     ):
         self.queue = queue
         self.store = store
         self.hub = hub
         self.serving = serving or ServingConfig()
+        self.metrics = metrics
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -139,6 +141,11 @@ class ApiServer:
                     self._serve_media()
                 elif path == "/healthz":
                     self._json(200, {"ok": True, "queue": api.queue.counts()})
+                elif path == "/metrics":
+                    snap = (api.metrics.snapshot()
+                            if api.metrics is not None else {})
+                    snap["queue"] = api.queue.counts()
+                    self._json(200, snap)
                 else:
                     self._json(404, {"error": "not found"})
 

@@ -1,0 +1,67 @@
+"""Serving telemetry: latency reservoir + counters + profiler hooks.
+
+Reference capability (SURVEY.md §5): observability in the reference is a
+wall-clock ``print`` per job (reference worker.py:544,657-658) and stdout
+breadcrumbs. Here a process-wide, thread-safe metrics object records
+per-request latency and per-task counters, exposed via ``GET /metrics``
+(serve/http_api.py), plus thin ``jax.profiler`` trace toggles for on-demand
+TPU traces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Dict, Optional
+
+
+class Metrics:
+    def __init__(self, reservoir: int = 2048):
+        self._lock = threading.Lock()
+        self._lat_ms: deque = deque(maxlen=reservoir)
+        self._by_task: Counter = Counter()
+        self._failures: Counter = Counter()
+        self._started = time.time()
+
+    def record(self, task_id: int, latency_ms: float) -> None:
+        with self._lock:
+            self._lat_ms.append(latency_ms)
+            self._by_task[task_id] += 1
+
+    def record_failure(self, task_id: Optional[int] = None) -> None:
+        with self._lock:
+            self._failures[task_id if task_id is not None else -1] += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            by_task = dict(self._by_task)
+            failures = dict(self._failures)
+
+        def pct(p: float) -> Optional[float]:
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 3)
+
+        return {
+            "uptime_s": round(time.time() - self._started, 1),
+            "requests": sum(by_task.values()),
+            "by_task": {str(k): v for k, v in sorted(by_task.items())},
+            "failures": {str(k): v for k, v in sorted(failures.items())},
+            "latency_ms": {"p50": pct(0.50), "p90": pct(0.90),
+                           "p99": pct(0.99), "n": len(lat)},
+        }
+
+
+def start_device_trace(log_dir: str) -> None:
+    """Begin a jax.profiler trace (view in TensorBoard/XProf)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_device_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

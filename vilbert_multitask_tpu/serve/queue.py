@@ -20,7 +20,7 @@ import os
 import sqlite3
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 
 @dataclass
@@ -74,8 +74,12 @@ class DurableQueue:
             return int(cur.lastrowid)
 
     # ---------------------------------------------------------------- consumer
-    def claim(self) -> Optional[Job]:
+    def claim(self, exclude: Sequence[int] = ()) -> Optional[Job]:
         """Atomically claim the oldest deliverable job (None if drained).
+
+        ``exclude`` skips specific job ids for this call — the batch worker
+        uses it so a failing job doesn't block or spin while its batchmates
+        drain.
 
         Also sweeps expired in-flight claims back to pending — the embedded
         equivalent of a broker's visibility timeout, covering worker crashes
@@ -97,10 +101,16 @@ class DurableQueue:
                 "WHERE queue=? AND status='pending' AND attempts >= ?",
                 (self.queue_name, self.max_delivery_attempts),
             )
+            exclude = list(exclude)
+            not_in = (
+                f" AND id NOT IN ({','.join('?' * len(exclude))})"
+                if exclude else ""
+            )
             row = c.execute(
                 "SELECT id, body, attempts FROM jobs "
-                "WHERE queue=? AND status='pending' ORDER BY id LIMIT 1",
-                (self.queue_name,),
+                f"WHERE queue=? AND status='pending'{not_in} "
+                "ORDER BY id LIMIT 1",
+                (self.queue_name, *exclude),
             ).fetchone()
             if row is None:
                 return None
@@ -135,6 +145,18 @@ class DurableQueue:
                 (status, job_id),
             )
             return status
+
+    def release(self, job_id: int) -> None:
+        """Un-claim without charging a delivery attempt, for consumers that
+        claim a job and then decline to process it (load shedding, graceful
+        shutdown with claims in hand). The batch worker's failure path uses
+        ``claim(exclude=...)`` instead — release is for *unprocessed* jobs."""
+        with self._conn() as c:
+            c.execute(
+                "UPDATE jobs SET status='pending', claimed_at=NULL, "
+                "attempts=MAX(attempts-1, 0) WHERE id=? AND status='inflight'",
+                (job_id,),
+            )
 
     # ------------------------------------------------------------------ introspection
     def counts(self) -> Dict[str, int]:

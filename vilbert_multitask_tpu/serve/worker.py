@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 from vilbert_multitask_tpu.config import ServingConfig, TASK_REGISTRY
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 from vilbert_multitask_tpu.serve.db import ResultStore
+from vilbert_multitask_tpu.serve.metrics import Metrics
 from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, Job
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
@@ -37,17 +38,24 @@ class ServeWorker:
         store: ResultStore,
         hub: PushHub,
         serving: Optional[ServingConfig] = None,
+        metrics: Optional[Metrics] = None,
     ):
         self.engine = engine
         self.queue = queue
         self.store = store
         self.hub = hub
         self.serving = serving or ServingConfig()
+        self.metrics = metrics or Metrics()
 
     # ------------------------------------------------------------- job cycle
-    def process_job(self, job: Job) -> Dict[str, Any]:
-        """One message end-to-end; raises on failure (caller nacks)."""
+    def _intake(self, job: Job):
+        """Validate + prepare one job: returns (qa_id, prepared, t0).
+
+        t0 is captured before feature I/O so solo and batched paths record
+        the same latency definition in :class:`Metrics`.
+        """
         body = job.body
+        t0 = time.perf_counter()
         task_id = int(body["task_id"])  # reference eval()s this str; we don't
         question = body.get("question", "")
         socket_id = body.get("socket_id", "")
@@ -56,58 +64,136 @@ class ServeWorker:
             image_paths = [image_paths]
         spec = TASK_REGISTRY[task_id]
         spec.validate_num_images(len(image_paths))
-
-        t0 = time.perf_counter()
         log_to_terminal(self.hub, socket_id,
                         {"terminal": f"Running {spec.name} inference..."})
-        # Keyed by the queue job id so redelivered attempts reuse one row.
+        # Audit row first (reference worker.py:548-552), keyed by the queue
+        # job id so redelivered attempts reuse one row.
         qa_id = self.store.create_question(task_id, question, image_paths,
                                            socket_id, queue_job_id=job.id)
+        regions = self.engine.feature_store.get_batch(image_paths)
+        prepared = self.engine.prepare(task_id, question, regions, image_paths)
+        return qa_id, prepared, t0
 
-        result = self.engine.predict(task_id, question, image_paths)
+    def process_job(self, job: Job) -> Dict[str, Any]:
+        """One message end-to-end; raises on failure (caller nacks)."""
+        qa_id, prepared, t0 = self._intake(job)
+        _, result = self.engine.run(prepared)
+        return self._finish_job(job, qa_id, prepared, result, t0)
+
+    def step(self) -> Optional[str]:
+        """Claim and run one job. Returns 'acked'/'failed'/None."""
+        job = self.queue.claim()
+        if job is None:
+            return None
+        return self.step_one(job)
+
+    def metrics_failure_for(self, job: Job) -> None:
+        try:
+            self.metrics.record_failure(int(job.body.get("task_id", -1)))
+        except (TypeError, ValueError):
+            self.metrics.record_failure()
+
+    # ------------------------------------------------------- micro-batching
+    def step_batch(self, max_jobs: int = 8) -> int:
+        """Drain up to ``max_jobs`` queued jobs and serve the packable
+        single-image ones in ONE forward (engine.run_many); multi-image jobs
+        claimed along the way run individually. Returns jobs completed.
+
+        This is the TPU-shaped replacement for the reference's strictly
+        serial batch=1 loop (worker.py:70,489,672-673): under queue backlog
+        the trunk runs once per bucket instead of once per request.
+        """
+        singles: List[tuple] = []  # (job, qa_id, prepared, t0)
+        done = 0
+        failed_ids: set = set()
+        while len(singles) < max_jobs:
+            job = self.queue.claim(exclude=failed_ids)
+            if job is None:
+                break
+            paths = job.body["image_path"]
+            if isinstance(paths, str):
+                paths = [paths]
+            if len(paths) != 1:
+                # multi-image semantics (pairs/retrieval): serve solo
+                if self.step_one(job) == "acked":
+                    done += 1
+                else:
+                    failed_ids.add(job.id)  # don't spin its attempts away
+                continue
+            try:
+                qa_id, prepared, t0 = self._intake(job)
+                singles.append((job, qa_id, prepared, t0))
+            except Exception:
+                self._fail_job(job)
+                failed_ids.add(job.id)
+        if not singles:
+            return done
+        try:
+            results = self.engine.run_many([p for _, _, p, _ in singles])
+        except Exception:
+            for job, _, _, _ in singles:
+                self._fail_job(job)
+            return done
+        for (job, qa_id, prepared, t0), result in zip(singles, results):
+            try:
+                self._finish_job(job, qa_id, prepared, result, t0)
+                self.queue.ack(job.id)
+                done += 1
+            except Exception:
+                self._fail_job(job)
+        return done
+
+    def _finish_job(self, job: Job, qa_id: int, req, result,
+                    t0) -> Dict[str, Any]:
+        """Marshal + persist + push for one completed request."""
+        body = job.body
+        socket_id = body.get("socket_id", "")
         payload = result.to_json()
-        payload["question"] = question
-        payload["task_name"] = spec.name
-
+        payload["question"] = body.get("question", "")
+        payload["task_name"] = req.spec.name
         answer_images: List[str] = []
         if result.kind == "grounding" and result.boxes:
-            src = image_paths[0]
+            src = req.images[0].path
             if os.path.exists(src):
                 out_dir = os.path.join(self.serving.media_root,
                                        self.serving.refer_expr_dir)
                 answer_images = draw_grounding_boxes(src, result.boxes, out_dir)
                 payload["result_images"] = answer_images
-
         self.store.save_answer(qa_id, payload, answer_images)
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.record(req.spec.task_id, elapsed_ms)
         log_to_terminal(self.hub, socket_id, {"result": payload})
         log_to_terminal(
             self.hub, socket_id,
-            {"terminal": f"Task completed in "
-                         f"{(time.perf_counter() - t0) * 1e3:.0f} ms"})
+            {"terminal": f"Task completed in {elapsed_ms:.0f} ms"})
         return payload
 
-    def step(self) -> Optional[str]:
-        """Claim and run one job. Returns 'acked'/'requeued'/'dead'/None."""
-        job = self.queue.claim()
-        if job is None:
-            return None
+    def _fail_job(self, job: Job) -> str:
+        """nack + telemetry; returns 'requeued' or 'dead'."""
+        self.metrics_failure_for(job)
+        status = self.queue.nack(job.id)
+        if status == "dead":
+            log_to_terminal(
+                self.hub, job.body.get("socket_id", ""),
+                {"terminal": "Job failed permanently.",
+                 "error": traceback.format_exc(limit=3)})
+        return "requeued" if status == "pending" else status
+
+    def step_one(self, job: Job) -> str:
+        """Run one already-claimed job solo (ack/nack included).
+
+        Returns 'acked', 'requeued', or 'dead'.
+        """
         try:
             self.process_job(job)
         except Exception:
-            status = self.queue.nack(job.id)
-            socket_id = job.body.get("socket_id", "")
-            if status == "dead":
-                log_to_terminal(
-                    self.hub, socket_id,
-                    {"terminal": "Job failed permanently.",
-                     "error": traceback.format_exc(limit=3)})
-            return "requeued" if status == "pending" else status
+            return self._fail_job(job)
         self.queue.ack(job.id)
         return "acked"
 
     def run_forever(self, *, poll_interval_s: float = 0.05,
-                    stop_event=None) -> None:
-        """The consume loop (reference worker.py:672-673), poll-based."""
+                    stop_event=None, batch_jobs: int = 8) -> None:
+        """The consume loop (reference worker.py:672-673), micro-batched."""
         while stop_event is None or not stop_event.is_set():
-            if self.step() is None:
+            if self.step_batch(batch_jobs) == 0:
                 time.sleep(poll_interval_s)

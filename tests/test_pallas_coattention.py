@@ -98,6 +98,54 @@ def test_model_parity_pallas_vs_xla(tiny_config, rng):
                                atol=1e-4, rtol=1e-4)
 
 
+def test_self_attention_pallas_matches_xla(rng):
+    """FusedSelfAttention kernel path (head_dim=128) ≡ XLA path."""
+    import flax.linen as nn
+
+    from vilbert_multitask_tpu.ops.attention import FusedSelfAttention
+
+    nrng = np.random.default_rng(7)
+    B, N, H = 2, 23, 256  # 2 heads × head_dim 128 → kernel-eligible
+    x = jnp.asarray(nrng.normal(size=(B, N, H)), jnp.float32)
+    mask = jnp.ones((B, N), jnp.int32).at[:, 17:].set(0)
+    bias = mask_to_bias(mask)
+    mod_x = FusedSelfAttention(hidden_size=H, num_heads=2, use_pallas=False)
+    mod_p = FusedSelfAttention(hidden_size=H, num_heads=2, use_pallas=True)
+    params = mod_x.init(rng, x, bias)["params"]
+    ref, probs = mod_x.apply({"params": params}, x, bias)
+    out, none_probs = mod_p.apply({"params": params}, x, bias)
+    assert none_probs is None and probs is not None
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_pretraining_heads_skippable(tiny_config, rng):
+    """compute_pretraining_heads=False drops only the masked-modeling heads."""
+    model = ViLBertForVLTasks(tiny_config, dtype=jnp.float32)
+    B, Nt, Nv = 2, 8, 5
+    args = (
+        jnp.zeros((B, Nt), jnp.int32),
+        jnp.zeros((B, Nv, tiny_config.v_feature_size), jnp.float32),
+        jnp.zeros((B, Nv, 5), jnp.float32),
+        jnp.zeros((B, Nt), jnp.int32),
+        jnp.ones((B, Nt), jnp.int32),
+        jnp.ones((B, Nv), jnp.int32),
+        None,
+        jnp.ones((B, 1), jnp.int32),
+    )
+    params = model.init(rng, *args, deterministic=True)["params"]
+    full = model.apply({"params": params}, *args, deterministic=True)
+    lean = model.apply({"params": params}, *args, deterministic=True,
+                       compute_pretraining_heads=False)
+    assert lean.linguisic_prediction is None
+    assert lean.vision_prediction is None
+    assert full.linguisic_prediction is not None
+    np.testing.assert_array_equal(np.asarray(lean.vil_prediction),
+                                  np.asarray(full.vil_prediction))
+    np.testing.assert_array_equal(np.asarray(lean.vision_logit),
+                                  np.asarray(full.vision_logit))
+
+
 def test_attention_maps_still_available_with_pallas_config(tiny_config, rng):
     """The visualization contract (reference worker.py:288) falls back to the
     probs-returning XLA path even when the Pallas flag is on."""

@@ -120,6 +120,34 @@ def test_sharded_train_step_on_mesh():
     assert ffn2.sharding == ffn_kernel.sharding
 
 
+def test_remat_matches_plain_gradients():
+    """cfg.remat changes memory/FLOPs, never values: same loss, same grads."""
+    import dataclasses
+
+    cfg, model, params, batch = _setup()
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    model_r = ViLBertForVLTasks(cfg_r, dtype=jnp.float32)
+    loss_cfg = LossConfig(heads=("vqa", "tri"))
+
+    def loss_fn(m):
+        def f(p):
+            out = m.apply(
+                {"params": p}, batch["input_ids"], batch["features"],
+                batch["spatials"], batch["segment_ids"], batch["input_mask"],
+                batch["image_mask"], None, batch["task_ids"],
+                deterministic=True,
+            )
+            return multitask_loss(loss_cfg, out, batch)[0]
+        return f
+
+    l0, g0 = jax.value_and_grad(loss_fn(model))(params)
+    l1, g1 = jax.value_and_grad(loss_fn(model_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        g0, g1)
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as g
 

@@ -80,6 +80,16 @@ class ViLBertConfig:
     # (ops/coattention.py). Off when attention maps are requested — the
     # blockwise kernel never materializes probabilities.
     use_pallas_coattention: bool = False
+    # Same kernel for the single-stream self-attention; a stream only takes
+    # the kernel path when its head_dim fills 128-lane tiles exactly (the
+    # 1024/8 visual stream does; BERT-base text's 64 would waste half the
+    # MXU, so it stays on XLA).
+    use_pallas_self_attention: bool = False
+    # Rematerialize encoder layers in the backward pass (jax.checkpoint via
+    # nn.remat): trades ~30% more FLOPs for activation memory that scales
+    # with ONE layer instead of the full 18-layer stack — the standard HBM
+    # lever for large-batch training.
+    remat: bool = False
 
     # --- heads ---
     num_labels: int = 3129  # VQA answer space (worker.py:523)
@@ -228,6 +238,7 @@ class EngineConfig:
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
     param_dtype: str = "float32"
     use_pallas_coattention: bool = False  # flip on TPU once kernel validated
+    use_pallas_self_attention: bool = False  # 128-aligned streams only
     donate_buffers: bool = True
 
     def bucket_for(self, n_images: int) -> int:

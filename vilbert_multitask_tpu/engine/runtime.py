@@ -83,11 +83,13 @@ class InferenceEngine:
         self.cfg = cfg or FrameworkConfig()
         ecfg = self.cfg.engine
         self.compute_dtype = jnp.dtype(ecfg.compute_dtype)
-        model_cfg = self.cfg.model
-        if ecfg.use_pallas_coattention != model_cfg.use_pallas_coattention:
-            model_cfg = dataclasses.replace(
-                model_cfg, use_pallas_coattention=ecfg.use_pallas_coattention
-            )
+        # Engine kernel knobs win over the model config, unconditionally —
+        # kernel selection must not depend on which config carried a flag.
+        model_cfg = dataclasses.replace(
+            self.cfg.model,
+            use_pallas_coattention=ecfg.use_pallas_coattention,
+            use_pallas_self_attention=ecfg.use_pallas_self_attention,
+        )
         self.model = ViLBertForVLTasks(model_cfg, dtype=self.compute_dtype)
         self.tokenizer = tokenizer or FullTokenizer(demo_vocab())
         self.feature_store = feature_store
@@ -147,6 +149,8 @@ class InferenceEngine:
                     batch["segment_ids"], batch["input_mask"],
                     batch["image_mask"], None, batch["task_ids"],
                     deterministic=True, output_all_attention_masks=attn,
+                    # serving decodes never read the masked-LM/region heads
+                    compute_pretraining_heads=False,
                 )
 
             self._compiled[key] = fwd

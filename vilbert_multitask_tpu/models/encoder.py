@@ -32,8 +32,15 @@ class TwoStreamEncoder(nn.Module):
 
     def setup(self):
         cfg = self.config
+        # Per-layer rematerialization: deterministic / need_probs are static
+        # (they steer Python control flow inside the layers).
+        t_layer_cls = TransformerLayer
+        c_layer_cls = ConnectionLayer
+        if cfg.remat:
+            t_layer_cls = nn.remat(TransformerLayer, static_argnums=(3,))
+            c_layer_cls = nn.remat(ConnectionLayer, static_argnums=(5, 6))
         self.t_layers = [
-            TransformerLayer(
+            t_layer_cls(
                 hidden_size=cfg.hidden_size,
                 num_heads=cfg.num_attention_heads,
                 intermediate_size=cfg.intermediate_size,
@@ -41,13 +48,14 @@ class TwoStreamEncoder(nn.Module):
                 hidden_dropout=cfg.hidden_dropout_prob,
                 attention_dropout=cfg.attention_probs_dropout_prob,
                 layer_norm_eps=cfg.layer_norm_eps,
+                use_pallas=cfg.use_pallas_self_attention,
                 dtype=self.dtype,
                 name=f"t_layer_{i}",
             )
             for i in range(cfg.num_hidden_layers)
         ]
         self.v_layers = [
-            TransformerLayer(
+            t_layer_cls(
                 hidden_size=cfg.v_hidden_size,
                 num_heads=cfg.v_num_attention_heads,
                 intermediate_size=cfg.v_intermediate_size,
@@ -55,13 +63,14 @@ class TwoStreamEncoder(nn.Module):
                 hidden_dropout=cfg.v_hidden_dropout_prob,
                 attention_dropout=cfg.v_attention_probs_dropout_prob,
                 layer_norm_eps=cfg.layer_norm_eps,
+                use_pallas=cfg.use_pallas_self_attention,
                 dtype=self.dtype,
                 name=f"v_layer_{i}",
             )
             for i in range(cfg.v_num_hidden_layers)
         ]
         self.c_layers = [
-            ConnectionLayer(
+            c_layer_cls(
                 hidden_size=cfg.hidden_size,
                 v_hidden_size=cfg.v_hidden_size,
                 bi_hidden_size=cfg.bi_hidden_size,
@@ -100,30 +109,29 @@ class TwoStreamEncoder(nn.Module):
         ):
             while t_ptr < t_stop:
                 t_hidden, _ = self.t_layers[t_ptr](
-                    t_hidden, t_mask_bias, deterministic=deterministic
+                    t_hidden, t_mask_bias, deterministic
                 )
                 t_ptr += 1
             while v_ptr < v_stop:
                 v_hidden, _ = self.v_layers[v_ptr](
-                    v_hidden, v_mask_bias, deterministic=deterministic
+                    v_hidden, v_mask_bias, deterministic
                 )
                 v_ptr += 1
             v_hidden, t_hidden, co_probs = self.c_layers[c_idx](
                 v_hidden, v_mask_bias, t_hidden, t_mask_bias,
-                deterministic=deterministic,
-                need_probs=collect_attention,
+                deterministic, collect_attention,
             )
             if collect_attention:
                 attn_maps.append(co_probs)
 
         while v_ptr < cfg.v_num_hidden_layers:
             v_hidden, _ = self.v_layers[v_ptr](
-                v_hidden, v_mask_bias, deterministic=deterministic
+                v_hidden, v_mask_bias, deterministic
             )
             v_ptr += 1
         while t_ptr < cfg.num_hidden_layers:
             t_hidden, _ = self.t_layers[t_ptr](
-                t_hidden, t_mask_bias, deterministic=deterministic
+                t_hidden, t_mask_bias, deterministic
             )
             t_ptr += 1
 

@@ -82,14 +82,16 @@ class TransformerLayer(nn.Module):
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
+    use_pallas: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask_bias, *, deterministic: bool = True):
+    def __call__(self, x, mask_bias, deterministic: bool = True):
         ctx, probs = FusedSelfAttention(
             hidden_size=self.hidden_size,
             num_heads=self.num_heads,
             dropout_rate=self.attention_dropout,
+            use_pallas=self.use_pallas,
             dtype=self.dtype,
             name="attention",
         )(x, mask_bias, deterministic=deterministic)
@@ -146,7 +148,6 @@ class ConnectionLayer(nn.Module):
         v_mask_bias,  # (B, 1, 1, Nv)
         t_hidden,  # (B, Nt, hidden)
         t_mask_bias,  # (B, 1, 1, Nt)
-        *,
         deterministic: bool = True,
         need_probs: bool = True,
     ) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:

@@ -43,9 +43,9 @@ class ViLBertOutput:
     vil_logit: jnp.ndarray  # (B, 1)                       retrieval alignment
     vil_binary_prediction: Optional[jnp.ndarray]  # (B//2, 2)  NLVR2 pairs
     vil_tri_prediction: jnp.ndarray  # (B, 3)              SNLI-VE
-    vision_prediction: jnp.ndarray  # (B, Nv, v_target)    masked-region head
+    vision_prediction: Optional[jnp.ndarray]  # (B, Nv, v_target) masked-region
     vision_logit: jnp.ndarray  # (B, Nv, 1)                grounding
-    linguisic_prediction: jnp.ndarray  # (B, Nt', vocab)   masked-LM head
+    linguisic_prediction: Optional[jnp.ndarray]  # (B, Nt', vocab) masked-LM
     linguisic_logit: jnp.ndarray  # (B, Nt', 1)            token grounding
     attn_data_list: List[Any]  # per-bridge (text→image, image→text) probs
 
@@ -152,7 +152,13 @@ class ViLBertForVLTasks(nn.Module):
         *,
         deterministic: bool = True,
         output_all_attention_masks: bool = False,
+        compute_pretraining_heads: bool = True,
     ) -> ViLBertOutput:
+        """``compute_pretraining_heads=False`` skips the masked-LM and
+        masked-region decoders — the widest matmuls in the head stack
+        (Nt'×vocab and Nv×v_target) — which no serving decode reads
+        (engine/decode.py); the reference computes them unconditionally
+        every request (worker.py:287-289). Training keeps the default."""
         cfg = self.config
         t_seq, v_seq, pooled_t, pooled_v, attn_maps, _ = self.bert(
             input_ids, features, spatials, segment_ids, input_mask, image_mask,
@@ -195,8 +201,11 @@ class ViLBertForVLTasks(nn.Module):
         linguisic_logit = self.linguisic_logit(self.head_dropout(
             t_seq, deterministic=deterministic))
 
-        linguisic_prediction = self.cls_text(t_seq, self.bert.embeddings.word_table)
-        vision_prediction = self.cls_image(v_seq)
+        linguisic_prediction = vision_prediction = None
+        if compute_pretraining_heads or self.is_initializing():
+            linguisic_prediction = self.cls_text(
+                t_seq, self.bert.embeddings.word_table)
+            vision_prediction = self.cls_image(v_seq)
 
         return ViLBertOutput(
             vil_prediction=vil_prediction,

@@ -71,6 +71,7 @@ class FusedSelfAttention(nn.Module):
     hidden_size: int
     num_heads: int
     dropout_rate: float = 0.1
+    use_pallas: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -80,9 +81,18 @@ class FusedSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (*x.shape[:-1], self.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        dropout_rng = None
-        if not deterministic and self.dropout_rate > 0.0:
-            dropout_rng = self.make_rng("dropout")
+        use_dropout = not deterministic and self.dropout_rate > 0.0
+        # Kernel path: self-attention probs are never surfaced (the encoder
+        # discards them, and the reference's attn_data_list carries only the
+        # co-attention maps), so only dropout and tile fit gate this.
+        if self.use_pallas and not use_dropout and head_dim % 128 == 0:
+            from vilbert_multitask_tpu.ops.coattention import (
+                flash_cross_attention,
+            )
+
+            ctx = flash_cross_attention(q, k, v, mask_bias)
+            return ctx.reshape(*x.shape[:-1], self.hidden_size), None
+        dropout_rng = self.make_rng("dropout") if use_dropout else None
         ctx, probs = multi_head_attention(
             q, k, v, mask_bias,
             dropout_rate=self.dropout_rate,

@@ -9,13 +9,29 @@ prints ONE JSON line:
 
 ``vs_baseline`` is target/measured against the <150 ms p50 target from
 BASELINE.json ("north_star"): >1.0 beats the target.
+
+Robustness (the round-1 run died in TPU backend init before producing any
+number): the default entrypoint is an ORCHESTRATOR that runs the measurement
+in a fresh subprocess, retries backend-init failures with backoff (a fresh
+process is the only reliable way to drop a poisoned PJRT client), and on
+total failure still emits the JSON line — with ``value: null`` and an
+``error`` field — instead of a stack trace.
+
+Env knobs:
+- ``BENCH_TINY=1``    tiny model config + CPU platform pinned in-process
+  (smoke runs; the real TPU run uses the 270M serving config).
+- ``BENCH_COMPARE=1`` after emitting the headline JSON, also measure the
+  inverted Pallas-kernel configuration and report the delta on stderr.
+- ``BENCH_ATTEMPTS`` / ``BENCH_ATTEMPT_TIMEOUT_S`` retry knobs.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import statistics
+import subprocess
 import sys
 import time
 
@@ -27,6 +43,7 @@ BASELINE_P50_MS = 150.0
 # backend is ~100x slower than a chip on the 270M config; the driver's TPU
 # run uses the real model).
 TINY = os.environ.get("BENCH_TINY", "") not in ("", "0")
+COMPARE = os.environ.get("BENCH_COMPARE", "") not in ("", "0")
 
 
 def synth_regions(rng, cfg, n_boxes=100):
@@ -58,63 +75,233 @@ ROUND_ROBIN = [
 ]
 
 
-def main() -> None:
-    import jax
+def _build_engine(pallas: bool | None):
+    """Engine with the serving config; ``pallas`` overrides the kernel knobs."""
+    import dataclasses
 
     from vilbert_multitask_tpu.config import FrameworkConfig
     from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 
     cfg = FrameworkConfig()
     if TINY:
-        import dataclasses
-
         cfg = dataclasses.replace(cfg, model=cfg.model.tiny())
-    rng = np.random.default_rng(0)
-    t0 = time.perf_counter()
-    engine = InferenceEngine(cfg)
-    init_s = time.perf_counter() - t0
-    regions = [synth_regions(rng, cfg) for _ in range(2)]
+    if pallas is not None:
+        cfg = dataclasses.replace(
+            cfg, engine=dataclasses.replace(
+                cfg.engine,
+                use_pallas_coattention=pallas,
+                use_pallas_self_attention=pallas,
+            ))
+    return cfg, InferenceEngine(cfg)
 
+
+def _measure(engine, cfg, *, budget_s: float = 45.0):
+    """Warm every bucket the round-robin hits, then time it."""
+    rng = np.random.default_rng(0)
+    regions = [synth_regions(rng, cfg) for _ in range(2)]
     reqs = [
         engine.prepare(task_id, q, regions[:n]) for task_id, q, n in ROUND_ROBIN
     ]
-
-    print(f"# engine init {init_s:.1f}s; compiling buckets...", file=sys.stderr)
+    # Warm exactly the buckets the timed loop hits: anything less recompiles
+    # mid-measurement, anything more burns the one hardware run on compiles.
+    buckets = sorted({r.bucket for r in reqs})
     t0 = time.perf_counter()
-    engine.warmup(buckets=(1, 2))
+    engine.warmup(buckets=buckets)
     warm_s = time.perf_counter() - t0
-    print(f"# warmup {warm_s:.1f}s; timing...", file=sys.stderr)
 
     # One untimed pass absorbs host-side caches, then the timed epochs.
     t0 = time.perf_counter()
     for req in reqs:
         engine.run(req)
     per_pass_s = time.perf_counter() - t0
-    # Scale timed work to ~60s so the bench fits a fixed budget on any
-    # backend (CPU smoke runs are ~100x slower than the TPU path).
-    epochs = max(1, min(8, int(60.0 / max(per_pass_s, 1e-3))))
-    lat_ms = []
+    # Scale timed work to the budget so the bench fits on any backend
+    # (CPU smoke runs are ~100x slower than the TPU path).
+    epochs = max(1, min(8, int(budget_s / max(per_pass_s, 1e-3))))
+    lat_ms, fwd_ms, dec_ms = [], [], []
     for _ in range(epochs):
         for req in reqs:
             t = time.perf_counter()
             engine.run(req)
             lat_ms.append((time.perf_counter() - t) * 1e3)
+            fwd_ms.append(engine.stage_times.get("forward_s", 0.0) * 1e3)
+            dec_ms.append(engine.stage_times.get("decode_s", 0.0) * 1e3)
+    return {
+        "warmup_s": round(warm_s, 1),
+        "n_queries": len(lat_ms),
+        "p50_ms": round(statistics.median(lat_ms), 3),
+        # nearest-rank p95 (ceil), clamped: correct at small sample counts
+        "p95_ms": round(sorted(lat_ms)[min(
+            len(lat_ms) - 1, math.ceil(0.95 * len(lat_ms)) - 1)], 3),
+        "forward_p50_ms": round(statistics.median(fwd_ms), 3),
+        "decode_p50_ms": round(statistics.median(dec_ms), 3),
+    }
 
-    p50 = statistics.median(lat_ms)
-    p95 = sorted(lat_ms)[int(0.95 * len(lat_ms)) - 1]
+
+def run_measurement() -> None:
+    """Child-process body: build, warm, time, print the JSON line."""
+    import jax
+
+    if TINY:
+        # Smoke mode means CPU: in this image a remote-TPU PJRT plugin wins
+        # over JAX_PLATFORMS=cpu from the environment, so pin in-process
+        # before backend init (same trick as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    t0 = time.perf_counter()
+    cfg, engine = _build_engine(None)
+    init_s = time.perf_counter() - t0
+    print(f"# engine init {init_s:.1f}s; compiling buckets...", file=sys.stderr)
+    pallas_fallback = False
+    if cfg.engine.use_pallas_coattention or cfg.engine.use_pallas_self_attention:
+        # Probe-compile the kernel path on this backend before committing the
+        # measurement to it: if Mosaic rejects the kernel here, degrade to the
+        # XLA attention path rather than losing the round's number.
+        try:
+            engine.warmup(buckets=(1,))
+        except Exception as e:  # noqa: BLE001
+            print(f"# pallas path failed to compile ({e}); falling back to "
+                  f"XLA attention", file=sys.stderr)
+            del engine
+            cfg, engine = _build_engine(False)
+            pallas_fallback = True
+    stats = _measure(engine, cfg)
     print(
-        f"# device={jax.devices()[0].device_kind} n_queries={len(lat_ms)} "
-        f"p50={p50:.2f}ms p95={p95:.2f}ms init={init_s:.1f}s "
-        f"warmup={warm_s:.1f}s",
+        f"# device={jax.devices()[0].device_kind} "
+        f"n_queries={stats['n_queries']} p50={stats['p50_ms']}ms "
+        f"p95={stats['p95_ms']}ms forward_p50={stats['forward_p50_ms']}ms "
+        f"decode_p50={stats['decode_p50_ms']}ms init={init_s:.1f}s "
+        f"warmup={stats['warmup_s']}s",
         file=sys.stderr,
     )
+    # Headline JSON goes out BEFORE the optional compare pass, so a hung or
+    # crashed compare can never cost the round its number.
     print(json.dumps({
         "metric": "p50_latency_ms",
-        "value": round(p50, 3),
+        "value": stats["p50_ms"],
         "unit": "ms",
-        "vs_baseline": round(BASELINE_P50_MS / p50, 3),
+        "vs_baseline": round(BASELINE_P50_MS / stats["p50_ms"], 3),
+        "p95_ms": stats["p95_ms"],
+        "forward_p50_ms": stats["forward_p50_ms"],
+        "decode_p50_ms": stats["decode_p50_ms"],
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "pallas_coattention": cfg.engine.use_pallas_coattention,
+        **({"pallas_fallback": True} if pallas_fallback else {}),
+    }), flush=True)
+    if COMPARE:
+        # Second engine with the kernel knobs inverted; same measurement.
+        # Stderr-only: the headline line above is already emitted.
+        try:
+            default_on = cfg.engine.use_pallas_coattention
+            del engine
+            alt_cfg, other = _build_engine(not default_on)
+            alt = _measure(other, alt_cfg, budget_s=30.0)
+            on_ms = stats["p50_ms"] if default_on else alt["p50_ms"]
+            off_ms = alt["p50_ms"] if default_on else stats["p50_ms"]
+            print(f"# pallas_on={on_ms}ms pallas_off={off_ms}ms",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"# compare path failed: {e}", file=sys.stderr)
+
+
+def main() -> None:
+    """Orchestrator: run the measurement in a subprocess, retry init flakes.
+
+    The round-1 failure mode was a one-shot `RuntimeError: Unable to
+    initialize backend 'axon'` killing the whole bench. Backend-init state
+    is process-global in JAX, so each attempt gets a fresh interpreter.
+    """
+    import collections
+    import threading
+
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1800"))
+    backoff_s = 30.0
+    last_err = "no attempts ran"
+    for i in range(1, attempts + 1):
+        print(f"# bench attempt {i}/{attempts}", file=sys.stderr)
+        # Child stderr streams through live (compile/warmup liveness lines)
+        # while a bounded tail is kept for the failure diagnostics.
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        tail: collections.deque = collections.deque(maxlen=40)
+        out_lines: list = []
+        got_json = threading.Event()
+
+        # One dedicated reader per pipe (communicate() would race the
+        # stderr pump for the same fd and lose lines arbitrarily).
+        def _pump_err(stream=proc.stderr, sink=tail):
+            for ln in stream:
+                sys.stderr.write(ln)
+                sink.append(ln.rstrip())
+
+        def _pump_out(stream=proc.stdout, sink=out_lines):
+            for ln in stream:
+                sink.append(ln)
+                if ln.startswith('{"metric"'):
+                    got_json.set()
+
+        pumps = [threading.Thread(target=_pump_err, daemon=True),
+                 threading.Thread(target=_pump_out, daemon=True)]
+        for t in pumps:
+            t.start()
+        # Once the headline JSON is on stdout the measurement is complete;
+        # anything after it (the BENCH_COMPARE pass) gets a bounded grace
+        # period instead of the full attempt timeout.
+        grace_s = float(os.environ.get("BENCH_COMPARE_GRACE_S", "900"))
+        deadline = time.monotonic() + timeout_s
+        timed_out = False
+        while proc.poll() is None:
+            if got_json.is_set():
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=grace_s if COMPARE else 10)
+                    except subprocess.TimeoutExpired:
+                        print("# headline JSON in hand; killing lingering "
+                              "child", file=sys.stderr)
+                        proc.kill()
+                        proc.wait()
+                break
+            if time.monotonic() >= deadline:
+                timed_out = True
+                proc.kill()
+                proc.wait()
+                break
+            time.sleep(0.5)
+        for t in pumps:
+            t.join(timeout=5)
+        # A headline line already on stdout is a valid measurement even if
+        # the child then hung or died (e.g. in the BENCH_COMPARE pass) —
+        # never throw away a number in hand.
+        json_line = next(
+            (ln for ln in out_lines if ln.startswith('{"metric"')), None)
+        if json_line:
+            print(json_line, end="" if json_line.endswith("\n") else "\n")
+            return
+        if timed_out:
+            last_err = (f"attempt {i} exceeded {timeout_s:.0f}s; last: "
+                        f"{tail[-1] if tail else 'no stderr'}")[:400]
+        else:
+            last = tail[-1] if tail else "no stderr"
+            last_err = f"attempt {i} rc={proc.returncode}: {last[:400]}"
+        print(f"# {last_err}", file=sys.stderr)
+        if i < attempts:
+            time.sleep(backoff_s * i)
+    # Total failure: still one parseable JSON line, now carrying diagnostics.
+    print(json.dumps({
+        "metric": "p50_latency_ms",
+        "value": None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "error": last_err,
     }))
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv[1:]:
+        run_measurement()
+    else:
+        main()

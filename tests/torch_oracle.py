@@ -1,0 +1,401 @@
+"""Independent PyTorch oracle for checkpoint-conversion validation.
+
+A minimal torch implementation of the two-stream ViLBERT forward whose
+``state_dict()`` carries the UPSTREAM key layout (the external ``vilbert``
+package the reference loads at worker.py:44-46,530-532: ``bert.encoder.layer.
+{i}.attention.self.query.weight`` …, ``bert.encoder.c_layer.{i}.biattention.
+query1/key1/value1/query2/key2/value2`` …, ``{head}.logit_fc.{0,2,3}`` …).
+
+This is NOT built from ``checkpoint/convert.py``'s name map — it expresses
+the upstream layout a second, independent time, in torch module structure and
+torch forward semantics. The parity test converts this module's random
+``state_dict()`` through :func:`convert_torch_state_dict` and asserts the
+Flax model reproduces its logits head-by-head, which fails if the bridge
+direction mapping (convert.py:129-143) or any kernel transpose is wrong
+(VERDICT round 1, item 3; SURVEY §7 hard part (a)).
+
+Upstream bi-attention direction convention encoded here (and nowhere else in
+this file's inputs): the ``*1`` projections act on the VISUAL stream, ``*2``
+on TEXT; text context = softmax(q2·k1ᵀ)·v1, visual context = softmax(q1·k2ᵀ)
+·v2; ``biOutput.dense1/LayerNorm1`` close the visual residual,
+``dense2/LayerNorm2`` the text residual.
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from vilbert_multitask_tpu.config import ViLBertConfig
+
+
+def _gelu(x):
+    return F.gelu(x)  # exact erf form, matching models/layers.py ACT["gelu"]
+
+
+def _heads_split(x, n_heads):
+    b, n, h = x.shape
+    return x.view(b, n, n_heads, h // n_heads).permute(0, 2, 1, 3)
+
+
+def _attend(q, k, v, bias):
+    """q,k,v: (B,H,N,D); bias additive (B,1,1,Nk). fp32 softmax."""
+    scores = q @ k.transpose(-1, -2) / math.sqrt(q.shape[-1])
+    scores = scores + bias
+    # softmax in the promoted dtype, matching ops/attention.py
+    probs = scores.to(torch.promote_types(scores.dtype, torch.float32)) \
+        .softmax(-1).to(q.dtype)
+    ctx = probs @ v
+    b, h, n, d = ctx.shape
+    return ctx.permute(0, 2, 1, 3).reshape(b, n, h * d)
+
+
+class _SelfAttention(nn.Module):
+    def __init__(self, hidden, n_heads):
+        super().__init__()
+        self.n_heads = n_heads
+        self.query = nn.Linear(hidden, hidden)
+        self.key = nn.Linear(hidden, hidden)
+        self.value = nn.Linear(hidden, hidden)
+
+    def forward(self, x, bias):
+        q = _heads_split(self.query(x), self.n_heads)
+        k = _heads_split(self.key(x), self.n_heads)
+        v = _heads_split(self.value(x), self.n_heads)
+        return _attend(q, k, v, bias)
+
+
+class _AttnOutput(nn.Module):
+    def __init__(self, hidden, eps):
+        super().__init__()
+        self.dense = nn.Linear(hidden, hidden)
+        self.LayerNorm = nn.LayerNorm(hidden, eps=eps)
+
+    def forward(self, ctx, residual):
+        return self.LayerNorm(self.dense(ctx) + residual)
+
+
+class _SelfAttnBlock(nn.Module):
+    """torch key shape: {prefix}.attention.self.* / {prefix}.attention.output.*"""
+
+    def __init__(self, hidden, n_heads, eps):
+        super().__init__()
+        self.self = _SelfAttention(hidden, n_heads)
+        self.output = _AttnOutput(hidden, eps)
+
+    def forward(self, x, bias):
+        return self.output(self.self(x, bias), x)
+
+
+class _Intermediate(nn.Module):
+    def __init__(self, hidden, inter):
+        super().__init__()
+        self.dense = nn.Linear(hidden, inter)
+
+    def forward(self, x):
+        return _gelu(self.dense(x))
+
+
+class _Output(nn.Module):
+    def __init__(self, inter, hidden, eps):
+        super().__init__()
+        self.dense = nn.Linear(inter, hidden)
+        self.LayerNorm = nn.LayerNorm(hidden, eps=eps)
+
+    def forward(self, h, residual):
+        return self.LayerNorm(self.dense(h) + residual)
+
+
+class _EncoderLayer(nn.Module):
+    """One single-stream layer: bert.encoder.layer.{i} / v_layer.{i}."""
+
+    def __init__(self, hidden, n_heads, inter, eps):
+        super().__init__()
+        self.attention = _SelfAttnBlock(hidden, n_heads, eps)
+        self.intermediate = _Intermediate(hidden, inter)
+        self.output = _Output(inter, hidden, eps)
+
+    def forward(self, x, bias):
+        x = self.attention(x, bias)
+        return self.output(self.intermediate(x), x)
+
+
+class _BiAttention(nn.Module):
+    """bert.encoder.c_layer.{i}.biattention.* — *1 on vision, *2 on text."""
+
+    def __init__(self, v_hidden, t_hidden, bi_hidden, n_heads):
+        super().__init__()
+        self.n_heads = n_heads
+        self.query1 = nn.Linear(v_hidden, bi_hidden)
+        self.key1 = nn.Linear(v_hidden, bi_hidden)
+        self.value1 = nn.Linear(v_hidden, bi_hidden)
+        self.query2 = nn.Linear(t_hidden, bi_hidden)
+        self.key2 = nn.Linear(t_hidden, bi_hidden)
+        self.value2 = nn.Linear(t_hidden, bi_hidden)
+
+    def forward(self, v_hidden, v_bias, t_hidden, t_bias):
+        q1 = _heads_split(self.query1(v_hidden), self.n_heads)
+        k1 = _heads_split(self.key1(v_hidden), self.n_heads)
+        v1 = _heads_split(self.value1(v_hidden), self.n_heads)
+        q2 = _heads_split(self.query2(t_hidden), self.n_heads)
+        k2 = _heads_split(self.key2(t_hidden), self.n_heads)
+        v2 = _heads_split(self.value2(t_hidden), self.n_heads)
+        t_ctx = _attend(q2, k1, v1, v_bias)  # text queries over vision
+        v_ctx = _attend(q1, k2, v2, t_bias)  # vision queries over text
+        return t_ctx, v_ctx
+
+
+class _BiOutput(nn.Module):
+    """bert.encoder.c_layer.{i}.biOutput.* — dense1/LN1 close the VISUAL
+    residual, dense2/LN2 the TEXT residual."""
+
+    def __init__(self, bi_hidden, v_hidden, t_hidden, eps):
+        super().__init__()
+        self.dense1 = nn.Linear(bi_hidden, v_hidden)
+        self.LayerNorm1 = nn.LayerNorm(v_hidden, eps=eps)
+        self.dense2 = nn.Linear(bi_hidden, t_hidden)
+        self.LayerNorm2 = nn.LayerNorm(t_hidden, eps=eps)
+
+    def forward(self, v_ctx, v_residual, t_ctx, t_residual):
+        v = self.LayerNorm1(self.dense1(v_ctx) + v_residual)
+        t = self.LayerNorm2(self.dense2(t_ctx) + t_residual)
+        return v, t
+
+
+class _ConnectionLayer(nn.Module):
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        eps = cfg.layer_norm_eps
+        self.biattention = _BiAttention(
+            cfg.v_hidden_size, cfg.hidden_size, cfg.bi_hidden_size,
+            cfg.bi_num_attention_heads)
+        self.biOutput = _BiOutput(
+            cfg.bi_hidden_size, cfg.v_hidden_size, cfg.hidden_size, eps)
+        self.v_intermediate = _Intermediate(cfg.v_hidden_size,
+                                            cfg.v_intermediate_size)
+        self.v_output = _Output(cfg.v_intermediate_size, cfg.v_hidden_size, eps)
+        self.t_intermediate = _Intermediate(cfg.hidden_size,
+                                            cfg.intermediate_size)
+        self.t_output = _Output(cfg.intermediate_size, cfg.hidden_size, eps)
+
+    def forward(self, v_hidden, v_bias, t_hidden, t_bias):
+        t_ctx, v_ctx = self.biattention(v_hidden, v_bias, t_hidden, t_bias)
+        v_hidden, t_hidden = self.biOutput(v_ctx, v_hidden, t_ctx, t_hidden)
+        v_hidden = self.v_output(self.v_intermediate(v_hidden), v_hidden)
+        t_hidden = self.t_output(self.t_intermediate(t_hidden), t_hidden)
+        return v_hidden, t_hidden
+
+
+class _Embeddings(nn.Module):
+    """bert.embeddings.* — task token inserted after [CLS]."""
+
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        if cfg.task_specific_tokens:
+            self.task_embeddings = nn.Embedding(cfg.num_task_tokens,
+                                                cfg.hidden_size)
+        self.LayerNorm = nn.LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self.task_specific_tokens = cfg.task_specific_tokens
+
+    def forward(self, input_ids, token_type_ids, task_ids):
+        n = input_ids.shape[1]
+        pos = torch.arange(n, device=input_ids.device)[None, :]
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(pos)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_specific_tokens:
+            task = self.task_embeddings(task_ids)  # (B, 1, H)
+            x = torch.cat([x[:, :1], task, x[:, 1:]], dim=1)
+        return self.LayerNorm(x)
+
+
+class _ImageEmbeddings(nn.Module):
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        self.image_embeddings = nn.Linear(cfg.v_feature_size, cfg.v_hidden_size)
+        self.image_location_embeddings = nn.Linear(5, cfg.v_hidden_size)
+        self.LayerNorm = nn.LayerNorm(cfg.v_hidden_size, eps=cfg.layer_norm_eps)
+
+    def forward(self, features, spatials):
+        return self.LayerNorm(self.image_embeddings(features)
+                              + self.image_location_embeddings(spatials))
+
+
+class _Encoder(nn.Module):
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        eps = cfg.layer_norm_eps
+        self.layer = nn.ModuleList(
+            _EncoderLayer(cfg.hidden_size, cfg.num_attention_heads,
+                          cfg.intermediate_size, eps)
+            for _ in range(cfg.num_hidden_layers))
+        self.v_layer = nn.ModuleList(
+            _EncoderLayer(cfg.v_hidden_size, cfg.v_num_attention_heads,
+                          cfg.v_intermediate_size, eps)
+            for _ in range(cfg.v_num_hidden_layers))
+        self.c_layer = nn.ModuleList(
+            _ConnectionLayer(cfg) for _ in range(cfg.num_connection_layers))
+        self.cfg = cfg
+
+    def forward(self, t_hidden, v_hidden, t_bias, v_bias):
+        cfg = self.cfg
+        t_ptr = v_ptr = 0
+        for c_idx, (v_stop, t_stop) in enumerate(
+                zip(cfg.v_biattention_id, cfg.t_biattention_id)):
+            while t_ptr < t_stop:
+                t_hidden = self.layer[t_ptr](t_hidden, t_bias)
+                t_ptr += 1
+            while v_ptr < v_stop:
+                v_hidden = self.v_layer[v_ptr](v_hidden, v_bias)
+                v_ptr += 1
+            v_hidden, t_hidden = self.c_layer[c_idx](
+                v_hidden, v_bias, t_hidden, t_bias)
+        while v_ptr < len(self.v_layer):
+            v_hidden = self.v_layer[v_ptr](v_hidden, v_bias)
+            v_ptr += 1
+        while t_ptr < len(self.layer):
+            t_hidden = self.layer[t_ptr](t_hidden, t_bias)
+            t_ptr += 1
+        return t_hidden, v_hidden
+
+
+class _Pooler(nn.Module):
+    def __init__(self, hidden, out):
+        super().__init__()
+        self.dense = nn.Linear(hidden, out)
+
+    def forward(self, x):
+        return F.relu(self.dense(x[:, 0]))
+
+
+class _Bert(nn.Module):
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        self.embeddings = _Embeddings(cfg)
+        self.v_embeddings = _ImageEmbeddings(cfg)
+        self.encoder = _Encoder(cfg)
+        self.t_pooler = _Pooler(cfg.hidden_size, cfg.bi_hidden_size)
+        self.v_pooler = _Pooler(cfg.v_hidden_size, cfg.bi_hidden_size)
+
+
+class _SimpleClassifier(nn.Module):
+    """torch Sequential(Linear, GELU, LayerNorm, Linear) → logit_fc.{0,2,3}."""
+
+    def __init__(self, in_dim, hidden, out, eps):
+        super().__init__()
+        self.logit_fc = nn.Sequential(
+            nn.Linear(in_dim, hidden), nn.GELU(),
+            nn.LayerNorm(hidden, eps=eps), nn.Linear(hidden, out))
+
+    def forward(self, x):
+        return self.logit_fc(x)
+
+
+class _PredictionTransform(nn.Module):
+    def __init__(self, in_dim, out_dim, eps):
+        super().__init__()
+        self.dense = nn.Linear(in_dim, out_dim)
+        self.LayerNorm = nn.LayerNorm(out_dim, eps=eps)
+
+    def forward(self, x):
+        return self.LayerNorm(_gelu(self.dense(x)))
+
+
+class _TextPredictions(nn.Module):
+    """cls.predictions.* — decoder tied to the word-embedding table."""
+
+    def __init__(self, cfg: ViLBertConfig, word_embedding: nn.Embedding):
+        super().__init__()
+        self.transform = _PredictionTransform(cfg.hidden_size, cfg.hidden_size,
+                                              cfg.layer_norm_eps)
+        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias=False)
+        self.decoder.weight = word_embedding.weight
+        self.bias = nn.Parameter(torch.zeros(cfg.vocab_size))
+
+    def forward(self, x):
+        return self.decoder(self.transform(x)) + self.bias
+
+
+class _ImagePredictions(nn.Module):
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        self.transform = _PredictionTransform(cfg.v_hidden_size,
+                                              cfg.v_hidden_size,
+                                              cfg.layer_norm_eps)
+        self.decoder = nn.Linear(cfg.v_hidden_size, cfg.v_target_size)
+
+    def forward(self, x):
+        return self.decoder(self.transform(x))
+
+
+class _Cls(nn.Module):
+    def __init__(self, cfg: ViLBertConfig, word_embedding: nn.Embedding):
+        super().__init__()
+        self.predictions = _TextPredictions(cfg, word_embedding)
+        self.imagePredictions = _ImagePredictions(cfg)
+
+
+class TorchViLBertOracle(nn.Module):
+    """Full serving model in the upstream torch layout (keys AND forward)."""
+
+    def __init__(self, cfg: ViLBertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = _Bert(cfg)
+        self.cls = _Cls(cfg, self.bert.embeddings.word_embeddings)
+        bi = cfg.bi_hidden_size
+        eps = cfg.layer_norm_eps
+        self.vil_prediction = _SimpleClassifier(bi, bi * 2, cfg.num_labels, eps)
+        self.vil_prediction_gqa = _SimpleClassifier(bi, bi * 2,
+                                                    cfg.gqa_num_labels, eps)
+        self.vil_binary_prediction = _SimpleClassifier(bi * 2, bi * 2, 2, eps)
+        self.vil_logit = nn.Linear(bi, 1)
+        self.vil_tri_prediction = nn.Linear(bi, 3)
+        self.vision_logit = nn.Linear(cfg.v_hidden_size, 1)
+        self.linguisic_logit = nn.Linear(cfg.hidden_size, 1)
+
+    @staticmethod
+    def _bias(mask):
+        return ((1.0 - mask.float()) * -10000.0)[:, None, None, :]
+
+    def forward(self, input_ids, features, spatials, segment_ids, input_mask,
+                image_mask, task_ids):
+        cfg = self.cfg
+        t_hidden = self.bert.embeddings(input_ids, segment_ids, task_ids)
+        if cfg.task_specific_tokens:
+            ones = torch.ones_like(input_mask[:, :1])
+            input_mask = torch.cat([input_mask[:, :1], ones, input_mask[:, 1:]],
+                                   dim=1)
+        v_hidden = self.bert.v_embeddings(features, spatials)
+        t_seq, v_seq = self.bert.encoder(
+            t_hidden, v_hidden, self._bias(input_mask), self._bias(image_mask))
+        pooled_t = self.bert.t_pooler(t_seq)
+        pooled_v = self.bert.v_pooler(v_seq)
+        pooled = pooled_t * pooled_v if cfg.fusion_method == "mul" \
+            else pooled_t + pooled_v
+
+        b = pooled.shape[0]
+        binary = None
+        if b % 2 == 0:
+            binary = self.vil_binary_prediction(pooled.view(b // 2, -1))
+        vision_logit = self.vision_logit(v_seq) + \
+            ((1.0 - image_mask.float()) * -10000.0)[:, :, None]
+        return {
+            "vil_prediction": self.vil_prediction(pooled),
+            "vil_prediction_gqa": self.vil_prediction_gqa(pooled),
+            "vil_logit": self.vil_logit(pooled),
+            "vil_binary_prediction": binary,
+            "vil_tri_prediction": self.vil_tri_prediction(pooled),
+            "vision_prediction": self.cls.imagePredictions(v_seq),
+            "vision_logit": vision_logit,
+            "linguisic_prediction": self.cls.predictions(t_seq),
+            "linguisic_logit": self.linguisic_logit(t_seq),
+        }

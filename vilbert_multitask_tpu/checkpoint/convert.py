@@ -201,13 +201,16 @@ def convert_torch_state_dict(
     *,
     strict: bool = True,
     report: Optional[Dict[str, List[str]]] = None,
+    dtype=np.float32,
 ) -> Dict:
     """Torch state dict (numpy-valued) → nested Flax param dict.
 
     ``strict`` raises when mapped torch keys are missing. Pass a dict as
     ``report`` to receive ``{"missing": [...], "unmapped": [...]}`` — torch
     keys the map does not cover (optimizer stats, pretraining-only heads)
-    are reported there instead of silently dropped.
+    are reported there instead of silently dropped. ``dtype`` is the param
+    storage dtype (float32 for serving; the conversion-oracle tests use
+    float64 so parity tolerances sit far below perturbation signals).
     """
     params: Dict = {}
     used: set = set()
@@ -219,7 +222,7 @@ def convert_torch_state_dict(
             missing.extend(k for k in torch_keys if k not in state_dict)
             continue
         used.update(torch_keys)
-        _set_path(params, flax_path, np.asarray(pack(*args), np.float32))
+        _set_path(params, flax_path, np.asarray(pack(*args), dtype))
     if strict and missing:
         raise KeyError(f"torch checkpoint missing {len(missing)} keys, "
                        f"e.g. {missing[:5]}")

@@ -48,9 +48,11 @@ def multi_head_attention(
     scores = scores * scale
     if bias is not None:
         scores = scores + bias.astype(dtype)
-    # softmax in fp32 for numerical stability under bf16 compute
+    # softmax at >= fp32 for numerical stability under bf16 compute
+    # (promote, don't pin: f64 runs — the conversion-oracle tests — keep f64)
+    softmax_dtype = jnp.promote_types(scores.dtype, jnp.float32)
     probs = jnp.asarray(
-        nn.softmax(scores.astype(jnp.float32), axis=-1), dtype=dtype
+        nn.softmax(scores.astype(softmax_dtype), axis=-1), dtype=dtype
     )
     if dropout_rate > 0.0 and not deterministic:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)

@@ -44,6 +44,15 @@ def engine(tiny_config):
     return InferenceEngine(cfg, seed=0)
 
 
+def test_engine_defaults_to_committed_assets(engine):
+    """No tokenizer/label args → the committed vocab + reference-layout
+    pickles load by default (never the in-memory demo vocab)."""
+    assert engine.tokenizer.cls_id == 101  # bert-base-uncased layout
+    assert len(engine.tokenizer.vocab) > 1000
+    assert engine.labels.get("vqa")[0] == "yes"  # from the committed pickle
+    assert len(engine.labels.get("vqa")) == 3129
+
+
 TASK_QUESTIONS = {
     1: "what is the man holding",
     2: "what color is the car",

@@ -257,6 +257,126 @@ def test_http_api_roundtrip(stack):
         api.stop()
 
 
+# ----------------------------------------------------- attention retrieval
+def test_attention_maps_requested_per_job(stack):
+    """collect_attention in the job message → per-bridge [CLS]→regions
+    summary in the result payload (reference worker.py:288 capability,
+    surfaced per request instead of computed-and-dropped)."""
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "what is this", 1, "sockAT",
+                               collect_attention=True))
+    # batched path must route the flagged job solo, not pack it
+    assert worker.step_batch() == 1
+    row = store.recent()[0]
+    attn = row["answer_text"]["attention"]
+    n_regions = worker.engine.cfg.engine.max_regions
+    assert attn["n_bridges"] == len(
+        worker.engine.cfg.model.v_biattention_id)
+    for bridge in attn["bridge_cls_to_regions"]:
+        assert len(bridge) == n_regions
+        assert abs(sum(bridge) - 1.0) < 1e-2  # a softmax row
+
+    # without the flag no attention payload is attached
+    q.publish(make_job_message(["img_a.jpg"], "what is this", 1, "sockAT"))
+    worker.step()
+    assert "attention" not in store.recent()[0]["answer_text"]
+
+
+# ------------------------------------------------------------------- admin
+def test_admin_browse_endpoints(stack):
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "admin probe", 1, "sockAD"))
+    worker.step()
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/admin/tasks")
+        tasks = json.loads(conn.getresponse().read())["tasks"]
+        assert {t["unique_id"] for t in tasks} >= {1, 12, 7}
+
+        conn.request("GET", "/admin/questionanswer?limit=1")
+        rows = json.loads(conn.getresponse().read())["rows"]
+        assert len(rows) == 1
+        assert rows[0]["input_text"] == "admin probe"
+        # socket_id is the websocket-stream credential: must be redacted
+        assert "socket_id" not in rows[0]
+
+        # limit is clamped: negative means "no limit" to sqlite — reject it
+        conn.request("GET", "/admin/questionanswer?limit=-1")
+        assert len(json.loads(conn.getresponse().read())["rows"]) >= 1
+    finally:
+        api.stop()
+
+
+# ---------------------------------------------------------------- frontend
+def test_frontend_served_to_browsers(stack):
+    """GET / with a browser Accept header returns the single-page app; API
+    clients keep the JSON contract; /config carries the websocket port and
+    per-task min/max image counts that drive the dropdown gating."""
+    s, hub, q, store, worker = stack
+    api = ApiServer(q, store, hub, s)
+    api.ws_port = 12345
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/", headers={"Accept": "text/html,*/*"})
+        resp = conn.getresponse()
+        html = resp.read().decode()
+        assert resp.status == 200
+        assert "text/html" in resp.getheader("Content-Type", "")
+        # the load-bearing UI pieces are present
+        for needle in ("GW_RE", "updateGating", "renderGrounding",
+                       "WebSocket", "upload_image"):
+            assert needle in html, needle
+
+        conn.request("GET", "/", headers={"Accept": "application/json"})
+        assert "tasks" in json.loads(conn.getresponse().read())
+
+        conn.request("GET", "/config")
+        cfg = json.loads(conn.getresponse().read())
+        assert cfg["ws_port"] == 12345
+        by_id = {t["unique_id"]: t for t in cfg["tasks"]}
+        assert by_id[12]["num_of_images_min"] == 2  # NLVR2 pair
+        assert by_id[7]["num_of_images_max"] == 10  # retrieval
+        assert by_id[1]["num_of_images_max"] == 1  # VQA single image
+    finally:
+        api.stop()
+
+
+# ------------------------------------------------------- mesh-aware binary
+def test_serveapp_serves_through_mesh(tiny_framework_cfg, features_dir,
+                                      tmp_path):
+    """The serving binary itself (not just the engine library) must build the
+    dp mesh when >1 device is visible and serve a job through it — the
+    round-1 gap where ServeApp ignored its MeshConfig."""
+    import dataclasses
+
+    import jax
+
+    from vilbert_multitask_tpu.serve.app import ServeApp
+
+    assert jax.device_count() >= 8  # conftest virtual mesh
+    cfg = dataclasses.replace(
+        tiny_framework_cfg,
+        serving=dataclasses.replace(
+            tiny_framework_cfg.serving,
+            queue_db_path=str(tmp_path / "q.sqlite3"),
+            results_db_path=str(tmp_path / "r.sqlite3"),
+            media_root=str(tmp_path / "media"),
+        ))
+    app = ServeApp(cfg, feature_root=features_dir)
+    assert app.engine.mesh is not None
+    assert app.engine.mesh.shape["dp"] == jax.device_count()
+
+    app.queue.publish(
+        make_job_message(["img_a.jpg", "img_b.jpg"], "a caption", 7, "sockM"))
+    assert app.worker.step() == "acked"
+    row = app.store.recent()[0]
+    assert row["answer_text"]["kind"] == "ranking"
+    assert len(row["answer_text"]["ranking"]) == 2
+
+
 # --------------------------------------------------------------- websocket
 def test_websocket_bridge_delivers(stack):
     pytest.importorskip("websockets")

@@ -1,0 +1,105 @@
+"""Tokenizer/label-asset parity tests (VERDICT round 1, item 4).
+
+The genuine bert-base-uncased vocab is not present in this image and cannot
+be fetched (zero egress), so exact-id parity against that asset is pinned
+two ways instead:
+
+1. ALGORITHM parity: the installed ``transformers`` BertTokenizer (the
+   lineage successor of the reference's ``pytorch_transformers`` tokenizer,
+   worker.py:42,537-539) is run over the SAME committed vocab file; our
+   pure-host implementation must produce identical ids for every fixture
+   sentence — basic-tokenization, lower-casing, accent stripping,
+   punctuation splits, greedy longest-match WordPiece, [UNK] behavior and
+   special-token placement all verified against an independent
+   implementation.
+2. STABILITY: a committed golden fixture pins the exact ids across rounds.
+
+When the real vocab file is swapped in (EngineConfig.vocab_path), the same
+algorithm produces the reference's exact ids — that is what (1) proves.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from vilbert_multitask_tpu import assets
+from vilbert_multitask_tpu.engine.labels import LabelMapStore
+from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+
+GOLDEN = pathlib.Path(__file__).parent / "fixtures" / "tokenizer_golden.json"
+
+SENTENCES = [
+    "what is the man holding",
+    "What COLOR is the CAR?",
+    "Is the bowl to the right of the mug?",
+    "don't stop, it's fine!!",
+    "a café near the résumé drop-off",  # combining accents
+    "two dogs (both black) are playing; really?",
+    "the qwzx unheard-of contraption",  # forces multi-piece + char fallback
+    "q: is it a person? a: no q: is it red? a: yes",
+    "  weird \t whitespace \n everywhere  ",
+    "12 bananas cost $3.50 at 7-eleven",
+    "今天 weather is nice",  # CJK chars split out
+    "skateboarding skateboarder skateboards",
+]
+
+
+@pytest.fixture(scope="module")
+def tok() -> FullTokenizer:
+    return FullTokenizer.from_vocab_file(assets.default_vocab_path())
+
+
+def test_special_token_ids_match_bert_base(tok):
+    """The committed vocab keeps bert-base-uncased's special ids, so the
+    checkpoint-visible contract ([CLS]=101 etc.) survives a vocab swap."""
+    assert tok.pad_id == 0
+    assert tok.vocab["[UNK]"] == 100
+    assert tok.cls_id == 101
+    assert tok.sep_id == 102
+    assert tok.vocab["[MASK]"] == 103
+
+
+def test_algorithm_parity_vs_transformers(tok):
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.BertTokenizer(
+        vocab_file=assets.default_vocab_path(), do_lower_case=True)
+    for s in SENTENCES:
+        ours = tok.encode(s)
+        theirs = hf.encode(s, add_special_tokens=False)
+        assert ours == theirs, f"ids diverge for {s!r}"
+        ours_special = tok.add_special_tokens_single_sentence(ours)
+        theirs_special = hf.encode(s, add_special_tokens=True)
+        assert ours_special == theirs_special, f"specials diverge for {s!r}"
+        assert tok.tokenize(s) == hf.tokenize(s), f"tokens diverge for {s!r}"
+
+
+def test_golden_ids_pinned(tok):
+    """Exact ids are pinned across rounds; regenerate deliberately with
+    tests/fixtures/regen via this file's __main__."""
+    golden = json.loads(GOLDEN.read_text())
+    assert list(golden) == SENTENCES, "fixture sentences drifted"
+    for s in SENTENCES:
+        assert tok.encode(s) == golden[s], f"golden drift for {s!r}"
+
+
+def test_label_assets_reference_layout():
+    """The committed label maps load through the reference's pickle layout
+    ({root}/{name}/cache/trainval_label2ans.pkl, worker.py:299,311) with the
+    exact head widths (3129 VQA / 1533 GQA)."""
+    store = LabelMapStore(root=assets.default_labels_root(),
+                          allow_synthetic=False)
+    vqa = store.get("vqa")
+    gqa = store.get("gqa")
+    assert len(vqa) == 3129 and vqa[0] == "yes" and vqa[1] == "no"
+    assert len(gqa) == 1533 and gqa[0] == "no"
+
+
+if __name__ == "__main__":
+    t = FullTokenizer.from_vocab_file(assets.default_vocab_path())
+    GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+    GOLDEN.write_text(json.dumps({s: t.encode(s) for s in SENTENCES},
+                                 indent=1))
+    print(f"wrote {GOLDEN}")

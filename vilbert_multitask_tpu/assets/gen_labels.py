@@ -1,0 +1,73 @@
+"""Deterministic generator for the committed answer-vocabulary assets.
+
+Emits VQA (3129 labels, reference worker.py:523) and GQA (1533) answer lists
+in the reference's exact on-disk layout —
+``{root}/{name}/cache/trainval_label2ans.pkl``, a pickled list[str]
+(reference worker.py:299-300,311-315) — so the serving default exercises the
+same loader code path the real assets will use. The real label pickles are
+not vendorable from this image (no egress, not present in /root/reference);
+the first entries are the well-known most-frequent VQAv2/GQA answers, the
+tail is explicit ``answer_###`` placeholders. Swap the files for the real
+pickles to get score parity; no code changes.
+
+Regenerate with ``python -m vilbert_multitask_tpu.assets.gen_labels``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+# Most-frequent VQAv2 answers (publicly documented ordering varies by cache;
+# this list is a realistic head, not a parity artifact).
+VQA_HEAD = [
+    "yes", "no", "2", "1", "white", "3", "red", "blue", "4", "green",
+    "black", "yellow", "brown", "5", "tennis", "baseball", "6", "orange",
+    "0", "bathroom", "wood", "right", "left", "frisbee", "pink", "gray",
+    "pizza", "7", "kitchen", "8", "cat", "skiing", "skateboarding", "dog",
+    "snow", "black and white", "surfing", "water", "red and white", "9",
+    "nothing", "kite", "blue and white", "wii", "grass", "umbrella",
+    "stop", "man", "woman", "phone", "food", "motorcycle", "bus", "train",
+    "horse", "sheep", "elephant", "zebra", "giraffe", "banana", "apple",
+    "sandwich", "broccoli", "carrot", "hot dog", "donut", "cake", "chair",
+    "couch", "bed", "laptop", "tv", "clock", "beach", "park", "street",
+    "day", "night", "summer", "winter", "sunny", "cloudy", "raining",
+    "daytime", "afternoon", "morning", "male", "female", "on table",
+    "in water", "standing", "sitting", "walking", "eating", "playing",
+]
+
+GQA_HEAD = [
+    "no", "yes", "left", "right", "man", "woman", "white", "black", "blue",
+    "red", "green", "brown", "gray", "yellow", "orange", "pink", "purple",
+    "color", "bottom", "top", "small", "large", "wood", "metal", "plastic",
+    "glass", "table", "chair", "window", "door", "wall", "floor", "grass",
+    "sky", "tree", "car", "bus", "train", "dog", "cat", "horse", "bird",
+    "boy", "girl", "shirt", "pants", "jacket", "hat", "standing",
+    "sitting", "walking", "eating", "playing", "open", "closed", "on",
+    "off", "indoors", "outdoors", "day", "night",
+]
+
+
+def _full(head: list[str], size: int, name: str) -> list[str]:
+    labels = list(head)
+    labels += [f"{name}_answer_{i}" for i in range(len(labels), size)]
+    assert len(labels) == size
+    return labels
+
+
+def main() -> list[str]:
+    root = os.path.join(os.path.dirname(__file__), "labels")
+    out = []
+    for name, head, size in (("vqa", VQA_HEAD, 3129), ("gqa", GQA_HEAD, 1533)):
+        d = os.path.join(root, name, "cache")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "trainval_label2ans.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(_full(head, size, name), f, protocol=2)
+        out.append(path)
+    return out
+
+
+if __name__ == "__main__":
+    for p in main():
+        print(p)

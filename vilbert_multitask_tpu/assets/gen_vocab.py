@@ -1,0 +1,140 @@
+"""Deterministic generator for the committed serving vocabulary.
+
+This image has no network egress and no bert-base-uncased asset anywhere on
+disk, so the real 30,522-token vocabulary cannot be vendored (VERDICT round 1
+item 4, adapted). Instead this script emits a REAL WordPiece vocabulary file
+in the standard one-token-per-line format whose STRUCTURE mirrors
+bert-base-uncased exactly:
+
+- ``[PAD]`` = 0, ``[unused0]``..``[unused98]`` = 1..99, ``[UNK]`` = 100,
+  ``[CLS]`` = 101, ``[SEP]`` = 102, ``[MASK]`` = 103 — the special ids the
+  12-in-1 checkpoint family bakes in (reference worker.py:402-403 encodes
+  with these), so swapping in the genuine vocab file later changes no
+  special-token id and no code;
+- printable-ASCII single characters and their ``##`` continuations, so any
+  ASCII word tokenizes to subwords rather than ``[UNK]`` (matching the real
+  vocab's behavior for rare words);
+- a curated vision-and-language word list (COCO object categories, VQA answer
+  words, question/function words) plus common English suffix pieces, giving
+  the greedy longest-match algorithm realistic multi-piece splits.
+
+Regenerate with ``python -m vilbert_multitask_tpu.assets.gen_vocab``; output
+is byte-stable.
+"""
+
+from __future__ import annotations
+
+import os
+
+SUFFIXES = [
+    "s", "es", "ed", "ing", "er", "est", "ly", "y", "ies", "ion", "tion",
+    "al", "ic", "ous", "ful", "less", "ness", "ment", "able", "ish", "en",
+    "an", "man", "men", "board", "ball", "light", "room", "time", "side",
+]
+
+# COCO-80 object categories (public list), split into single words.
+COCO = """person bicycle car motorcycle airplane bus train truck boat
+traffic light fire hydrant stop sign parking meter bench bird cat dog horse
+sheep cow elephant bear zebra giraffe backpack umbrella handbag tie suitcase
+frisbee skis snowboard sports ball kite baseball bat glove skateboard
+surfboard tennis racket bottle wine glass cup fork knife spoon bowl banana
+apple sandwich orange broccoli carrot hot pizza donut cake chair couch potted
+plant bed dining table toilet tv laptop mouse remote keyboard cell phone
+microwave oven toaster sink refrigerator book clock vase scissors teddy hair
+drier toothbrush""".split()
+
+WORDS = """
+the a an is are was were am be been being do does did doing have has had
+having will would can could shall should may might must not no yes none
+what which who whom whose where when why how many much some any all both
+few more most other another such only own same so than too very just
+i you he she it we they me him her us them my your his its our their this
+that these those there here and or but if because as until while of at by
+for with about against between into through during before after above below
+to from up down in out on off over under again further then once
+man woman boy girl child children adult people player rider driver worker
+face head eye ear nose mouth hand arm leg foot feet hair beard body finger
+shirt pants jacket coat dress hat cap helmet shoe sock scarf uniform jeans
+shorts skirt suit sunglasses watch bag purse
+red green blue yellow white black brown gray grey pink purple tan beige
+golden silver dark light bright colorful
+zero one two three four five six seven eight nine ten eleven twelve
+thirteen fourteen fifteen twenty thirty forty fifty hundred first second
+third last single double several pair group bunch crowd
+big small large little tall short long wide narrow thick thin huge tiny
+old young new modern round square flat curved empty full open closed clean
+dirty wet dry hot cold warm cool sunny cloudy rainy snowy bright shiny
+happy sad angry surprised tired hungry cute funny scary dangerous safe
+wood wooden metal plastic glass paper stone brick concrete leather fabric
+water snow rain ice sand grass tree trees bush flower flowers leaf leaves
+branch sky cloud clouds sun moon star mountain hill field forest beach
+ocean sea lake river road street sidewalk path bridge building house home
+wall floor ceiling roof window door fence gate yard garden park playground
+kitchen bathroom bedroom office store shop market restaurant school city
+town farm zoo station airport harbor court
+eat eating drink drinking hold holding wear wearing ride riding play
+playing stand standing sit sitting walk walking run running jump jumping
+fly flying swim swimming sleep sleeping look looking watch watching read
+reading write writing talk talking smile smiling laugh laughing wait
+waiting work working cook cooking cut cutting throw throwing catch
+catching kick kicking hit hitting carry carrying pull pulling push pushing
+point pointing reach reaching lean leaning lie lying feed feeding brush
+brushing wash washing drive driving park parking turn turning cross
+crossing climb climbing surf surfing ski skiing skate skating race racing
+serve serving toss tossing swing swinging
+left right top bottom middle center front back near far next behind beside
+under above inside outside around corner edge end side
+color kind type number amount time day night morning afternoon evening
+weather season summer winter spring fall scene picture image photo
+background foreground shadow reflection
+food meal breakfast lunch dinner snack fruit vegetable meat bread cheese
+egg rice pasta soup salad sauce butter sugar salt pepper coffee tea milk
+juice soda beer drink dessert chocolate cookie cream
+plate dish tray pan pot lid napkin towel basket box container jar can
+bag plane jet helicopter ship sail engine wheel tire door seat
+animal pet bird fish duck goose chicken pig goat rabbit deer monkey lion
+tiger fox wolf squirrel turtle frog insect bee butterfly spider
+tail wing paw horn fur feather
+ball bat racket net goal team game sport match player field court track
+kite board wave rope pole flag sign signal lamp lantern candle
+computer screen monitor television phone camera radio speaker clock
+machine device button switch wire cable battery
+table desk shelf cabinet drawer counter bench stool sofa cushion pillow
+blanket curtain mirror picture frame painting poster rug carpet stair
+toy doll kite balloon game card
+q start answer stop question guess true false entailment neutral
+contradiction
+""".split()
+
+
+def build_vocab() -> list[str]:
+    tokens: list[str] = ["[PAD]"]
+    tokens += [f"[unused{i}]" for i in range(99)]
+    tokens += ["[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    seen = set(tokens)
+
+    def add(tok: str) -> None:
+        if tok and tok not in seen:
+            seen.add(tok)
+            tokens.append(tok)
+
+    for c in range(33, 127):
+        add(chr(c))
+    for c in range(33, 127):
+        add("##" + chr(c))
+    for suf in SUFFIXES:
+        add("##" + suf)
+    for w in sorted(set(w.lower() for w in [*COCO, *WORDS])):
+        add(w)
+    return tokens
+
+
+def main() -> str:
+    out_path = os.path.join(os.path.dirname(__file__), "wordpiece_vocab.txt")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(build_vocab()) + "\n")
+    return out_path
+
+
+if __name__ == "__main__":
+    print(main())

@@ -126,7 +126,9 @@ class ViLBertConfig:
     def tiny(self, **overrides) -> "ViLBertConfig":
         """A scaled-down config for CPU tests (same topology, small dims)."""
         small = dict(
-            vocab_size=512,
+            # >= the committed assets/wordpiece_vocab.txt size, so tiny
+            # models accept ids from the default serving tokenizer.
+            vocab_size=1088,
             hidden_size=48,
             num_hidden_layers=4,
             num_attention_heads=4,
@@ -239,7 +241,11 @@ class EngineConfig:
     param_dtype: str = "float32"
     use_pallas_coattention: bool = False  # flip on TPU once kernel validated
     use_pallas_self_attention: bool = False  # 128-aligned streams only
-    donate_buffers: bool = True
+    # Text/label assets. None → the committed defaults in assets/ (real
+    # file-loading code paths; swap the files for the genuine bert-base-
+    # uncased vocab / reference label pickles to get score parity).
+    vocab_path: str | None = None
+    labels_root: str | None = None
 
     def bucket_for(self, n_images: int) -> int:
         for b in self.image_buckets:

@@ -47,8 +47,9 @@ from vilbert_multitask_tpu.features.pipeline import (
 from vilbert_multitask_tpu.features.store import FeatureStore
 from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks, ViLBertOutput
 from vilbert_multitask_tpu.parallel import sharding as shd
+from vilbert_multitask_tpu import assets
 from vilbert_multitask_tpu.text.pipeline import EncodedText, encode_question
-from vilbert_multitask_tpu.text.wordpiece import FullTokenizer, demo_vocab
+from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
 
 
 @dataclasses.dataclass
@@ -91,9 +92,13 @@ class InferenceEngine:
             use_pallas_self_attention=ecfg.use_pallas_self_attention,
         )
         self.model = ViLBertForVLTasks(model_cfg, dtype=self.compute_dtype)
-        self.tokenizer = tokenizer or FullTokenizer(demo_vocab())
+        # Default assets: the committed vocab/label files — real file-loading
+        # paths (reference worker.py:537-539, 299-315), not in-memory toys.
+        self.tokenizer = tokenizer or FullTokenizer.from_vocab_file(
+            ecfg.vocab_path or assets.default_vocab_path())
         self.feature_store = feature_store
         self.labels = label_store or LabelMapStore(
+            root=ecfg.labels_root or assets.default_labels_root(),
             sizes={"vqa": self.cfg.model.num_labels,
                    "gqa": self.cfg.model.gqa_num_labels}
         )

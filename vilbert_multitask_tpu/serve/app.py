@@ -41,8 +41,18 @@ class ServeApp:
                 from vilbert_multitask_tpu.checkpoint import restore_params
 
                 params = restore_params(checkpoint_path)
+            # Multi-device host → serve through the dp×tp mesh; a 1-chip box
+            # gets plain single-device jit. Same binary either way (the
+            # MeshConfig dp=-1 default absorbs whatever is visible).
+            import jax
+
+            mesh = None
+            if jax.device_count() > 1:
+                from vilbert_multitask_tpu.parallel import build_mesh
+
+                mesh = build_mesh(self.cfg.mesh)
             engine = InferenceEngine(
-                self.cfg, params=params,
+                self.cfg, params=params, mesh=mesh,
                 feature_store=FeatureStore(feature_root))
         self.engine = engine
         self.worker = ServeWorker(self.engine, self.queue, self.store,
@@ -55,8 +65,11 @@ class ServeApp:
         self._worker_thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        self.http_port = self.api.start()
+        # Websocket first: /config must never advertise an unbound ws port
+        # (the browser caches it and would reconnect to ws://host:0 forever).
         self.ws.start()
+        self.api.ws_port = self.ws.bound_port
+        self.http_port = self.api.start()
         self._worker_thread = threading.Thread(
             target=self.worker.run_forever,
             kwargs={"stop_event": self._stop},
@@ -78,8 +91,10 @@ def main(argv=None) -> None:
     p.add_argument("--checkpoint", default=None,
                    help="Orbax checkpoint dir (from checkpoint.convert_and_"
                         "save); omitting it serves RANDOM weights")
-    p.add_argument("--warmup", action="store_true",
-                   help="pre-compile all shape buckets before accepting jobs")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-compiling shape buckets at boot (first "
+                        "live request per bucket then pays the compile — "
+                        "directly against the p50 target; debug only)")
     args = p.parse_args(argv)
 
     app = ServeApp(feature_root=args.features,
@@ -87,7 +102,8 @@ def main(argv=None) -> None:
     if args.checkpoint is None:
         print("WARNING: no --checkpoint given; serving randomly initialized "
               "weights (answers will be meaningless)")
-    if args.warmup:
+    if not args.no_warmup:
+        print("warming shape buckets...")
         app.engine.warmup()
     app.start()
     s = app.cfg.serving

@@ -52,15 +52,31 @@ class ResultStore:
                 "CREATE UNIQUE INDEX IF NOT EXISTS qa_by_job ON "
                 "question_answers (queue_job_id) WHERE queue_job_id IS NOT NULL"
             )
-            # Seed the task catalog from the typed registry (replaces the
-            # reference's hand-entered admin rows, demo/models.py:4-20).
+            # In-code migration (component row 14): the min/max image-count
+            # columns drive the browser's task gating; older stores get them
+            # added in place.
+            for col in ("num_of_images_min", "num_of_images_max"):
+                try:
+                    c.execute(f"ALTER TABLE tasks ADD COLUMN {col} INTEGER")
+                except sqlite3.OperationalError:
+                    pass  # already present
+            # Seed/refresh the task catalog from the typed registry (replaces
+            # the reference's hand-entered admin rows, demo/models.py:4-20);
+            # the registry is the source of truth on every boot.
             for spec in TASK_REGISTRY.values():
                 c.execute(
-                    "INSERT OR IGNORE INTO tasks "
-                    "(unique_id, name, placeholder, description, num_of_images)"
-                    " VALUES (?, ?, ?, ?, ?)",
+                    "INSERT INTO tasks (unique_id, name, placeholder, "
+                    "description, num_of_images, num_of_images_min, "
+                    "num_of_images_max) VALUES (?, ?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(unique_id) DO UPDATE SET name=excluded.name, "
+                    "placeholder=excluded.placeholder, "
+                    "description=excluded.description, "
+                    "num_of_images=excluded.num_of_images, "
+                    "num_of_images_min=excluded.num_of_images_min, "
+                    "num_of_images_max=excluded.num_of_images_max",
                     (spec.task_id, spec.name, spec.placeholder,
-                     spec.description, spec.max_images),
+                     spec.description, spec.max_images, spec.min_images,
+                     spec.max_images),
                 )
 
     def _conn(self) -> sqlite3.Connection:
@@ -69,27 +85,25 @@ class ResultStore:
         return conn
 
     # ------------------------------------------------------------------ tasks
+    _TASK_COLS = ("unique_id", "name", "placeholder", "description",
+                  "num_of_images", "num_of_images_min", "num_of_images_max")
+
     def get_task(self, task_id: int) -> Optional[Dict[str, Any]]:
         with self._conn() as c:
             row = c.execute(
-                "SELECT unique_id, name, placeholder, description, "
-                "num_of_images FROM tasks WHERE unique_id=?",
+                f"SELECT {', '.join(self._TASK_COLS)} FROM tasks "
+                "WHERE unique_id=?",
                 (task_id,),
             ).fetchone()
-        if row is None:
-            return None
-        return dict(zip(
-            ("unique_id", "name", "placeholder", "description",
-             "num_of_images"), row))
+        return None if row is None else dict(zip(self._TASK_COLS, row))
 
     def list_tasks(self) -> List[Dict[str, Any]]:
-        cols = ("unique_id", "name", "placeholder", "description",
-                "num_of_images")
         with self._conn() as c:
             rows = c.execute(
-                f"SELECT {', '.join(cols)} FROM tasks ORDER BY unique_id"
+                f"SELECT {', '.join(self._TASK_COLS)} FROM tasks "
+                "ORDER BY unique_id"
             ).fetchall()
-        return [dict(zip(cols, r)) for r in rows]
+        return [dict(zip(self._TASK_COLS, r)) for r in rows]
 
     # --------------------------------------------------------------- QA rows
     def create_question(self, task_id: int, input_text: str,

@@ -50,6 +50,9 @@ class ApiServer:
         self.hub = hub
         self.serving = serving or ServingConfig()
         self.metrics = metrics
+        # Actual websocket port for the browser client; ServeApp overwrites
+        # this after the bridge binds (ws_port=0 picks a free port in tests).
+        self.ws_port: int = self.serving.ws_port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -74,7 +77,9 @@ class ApiServer:
         log_to_terminal(self.hub, socket_id,
                         {"info": f"Starting {spec.name} job..."})
         job_id = self.queue.publish(
-            make_job_message(images, question, task_id, socket_id))
+            make_job_message(
+                images, question, task_id, socket_id,
+                collect_attention=bool(payload.get("collect_attention"))))
         return 200, {"job_id": job_id, "task": spec.name}
 
     def task_details(self, task_id: int) -> Tuple[int, Dict[str, Any]]:
@@ -93,7 +98,14 @@ class ApiServer:
             ]
         if len(files) > count:
             files = random.sample(files, count)
-        return 200, {"demo_images": files}
+        return 200, {
+            "demo_images": files,
+            # Browser-facing URLs paired index-for-index with the paths the
+            # submit payload uses (paths key the feature store; urls render).
+            "demo_image_urls": [
+                "/media/demo/" + os.path.basename(f) for f in files
+            ],
+        }
 
     def save_upload(self, filename: str, data: bytes) -> str:
         """uuid-rename into media/demo (reference views.py:84-103)."""
@@ -124,9 +136,22 @@ class ApiServer:
             def do_GET(self):
                 path = self.path.rstrip("/") or "/"
                 if path == "/":
+                    # Browsers get the single-page demo app (the reference's
+                    # index.html render, views.py:39-42); API clients keep
+                    # the JSON contract.
+                    if "text/html" in self.headers.get("Accept", ""):
+                        self._serve_index()
+                        return
                     self._json(200, {
                         "tasks": api.store.list_tasks(),
                         "socket_id": str(uuid.uuid4()),
+                    })
+                elif path == "/config":
+                    self._json(200, {
+                        "ws_port": api.ws_port,
+                        "socket_id": str(uuid.uuid4()),
+                        "tasks": api.store.list_tasks(),
+                        "max_upload_images": api.serving.max_upload_images,
                     })
                 elif path.startswith("/get_task_details/"):
                     try:
@@ -139,6 +164,27 @@ class ApiServer:
                     self._json(*api.demo_images())
                 elif self.path.startswith("/media/"):
                     self._serve_media()
+                elif path == "/admin/tasks":
+                    # Browse surface over the task catalog
+                    # (reference demo/admin.py:7-21 TaskAdmin list view).
+                    self._json(200, {"tasks": api.store.list_tasks()})
+                elif path.startswith("/admin/questionanswer"):
+                    # QA audit-log browse (reference demo/admin.py:24-34
+                    # QuestionAnswerAdmin: newest-first, readonly).
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["50"])[0])
+                    except ValueError:
+                        limit = 50
+                    limit = max(1, min(limit, 500))
+                    rows = api.store.recent(limit=limit)
+                    # socket_id is the only credential for subscribing to a
+                    # client's websocket stream — never expose it here.
+                    for r in rows:
+                        r.pop("socket_id", None)
+                    self._json(200, {"rows": rows})
                 elif path == "/healthz":
                     self._json(200, {"ok": True, "queue": api.queue.counts()})
                 elif path == "/metrics":
@@ -148,6 +194,21 @@ class ApiServer:
                     self._json(200, snap)
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _serve_index(self):
+                page = os.path.join(os.path.dirname(__file__), "static",
+                                    "index.html")
+                try:
+                    with open(page, "rb") as f:
+                        body = f.read()
+                except OSError:
+                    self._json(500, {"error": "frontend asset missing"})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _serve_media(self):
                 rel = self.path[len("/media/"):].lstrip("/")

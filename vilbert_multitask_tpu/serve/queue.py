@@ -179,12 +179,22 @@ class DurableQueue:
 
 
 def make_job_message(image_paths, question: str, task_id: int,
-                     socket_id: str) -> Dict[str, Any]:
+                     socket_id: str, *,
+                     collect_attention: bool = False) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
-    list of absolute paths, ``question`` the (pre-lowercased) query."""
-    return {
+    list of absolute paths, ``question`` the (pre-lowercased) query.
+
+    ``collect_attention`` extends the schema: the reference requests
+    per-layer attention maps on every forward (worker.py:288,
+    ``output_all_attention_masks=True``) but never surfaces them; here the
+    maps are opt-in per job and a summary rides back in the result payload.
+    """
+    msg = {
         "image_path": list(image_paths),
         "question": question,
         "task_id": str(task_id),  # reference sends str; worker eval()s it
         "socket_id": socket_id,
     }
+    if collect_attention:
+        msg["collect_attention"] = True
+    return msg

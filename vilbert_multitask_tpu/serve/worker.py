@@ -28,6 +28,28 @@ from vilbert_multitask_tpu.serve.queue import DurableQueue, Job
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
 
 
+def _attention_summary(out) -> Dict[str, Any]:
+    """Compact, JSON-safe view of the co-attention maps for one request.
+
+    The reference computes per-layer maps on every forward
+    (worker.py:288) but the demo never renders them; here the serving
+    contract surfaces the useful slice — per-bridge, head-averaged [CLS]-row
+    text→image attention over the regions (the grounding-relevant signal) —
+    small enough to ride in the websocket result frame.
+    """
+    import numpy as np
+
+    bridges = []
+    for probs_t2v, _probs_v2t in out.attn_data_list:
+        if probs_t2v is None:
+            continue
+        p = np.asarray(probs_t2v, np.float32)[0]  # (H, Nq, Nk), request row 0
+        cls_over_regions = p.mean(axis=0)[0]  # head-avg, [CLS] query row
+        bridges.append([round(float(x), 5) for x in cls_over_regions])
+    return {"bridge_cls_to_regions": bridges,
+            "n_bridges": len(bridges)}
+
+
 class ServeWorker:
     """Single-process inference worker (one engine, one queue consumer)."""
 
@@ -77,8 +99,11 @@ class ServeWorker:
     def process_job(self, job: Job) -> Dict[str, Any]:
         """One message end-to-end; raises on failure (caller nacks)."""
         qa_id, prepared, t0 = self._intake(job)
-        _, result = self.engine.run(prepared)
-        return self._finish_job(job, qa_id, prepared, result, t0)
+        collect = bool(job.body.get("collect_attention", False))
+        out, result = self.engine.run(prepared, collect_attention=collect)
+        attention = _attention_summary(out) if collect else None
+        return self._finish_job(job, qa_id, prepared, result, t0,
+                                attention=attention)
 
     def step(self) -> Optional[str]:
         """Claim and run one job. Returns 'acked'/'failed'/None."""
@@ -113,8 +138,9 @@ class ServeWorker:
             paths = job.body["image_path"]
             if isinstance(paths, str):
                 paths = [paths]
-            if len(paths) != 1:
-                # multi-image semantics (pairs/retrieval): serve solo
+            if len(paths) != 1 or job.body.get("collect_attention"):
+                # multi-image semantics (pairs/retrieval) and attention-map
+                # requests (per-request forward flag): serve solo
                 if self.step_one(job) == "acked":
                     done += 1
                 else:
@@ -144,13 +170,16 @@ class ServeWorker:
         return done
 
     def _finish_job(self, job: Job, qa_id: int, req, result,
-                    t0) -> Dict[str, Any]:
+                    t0, attention: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
         """Marshal + persist + push for one completed request."""
         body = job.body
         socket_id = body.get("socket_id", "")
         payload = result.to_json()
         payload["question"] = body.get("question", "")
         payload["task_name"] = req.spec.name
+        if attention is not None:
+            payload["attention"] = attention
         answer_images: List[str] = []
         if result.kind == "grounding" and result.boxes:
             src = req.images[0].path
@@ -159,6 +188,14 @@ class ServeWorker:
                                        self.serving.refer_expr_dir)
                 answer_images = draw_grounding_boxes(src, result.boxes, out_dir)
                 payload["result_images"] = answer_images
+                # Web paths for the browser client (the reference hardcodes
+                # a production hostname instead, result.html:116-123 — a
+                # §2.4 trap knowingly fixed).
+                payload["result_image_urls"] = [
+                    "/media/" + "/".join(
+                        (self.serving.refer_expr_dir, os.path.basename(p)))
+                    for p in answer_images
+                ]
         self.store.save_answer(qa_id, payload, answer_images)
         elapsed_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.record(req.spec.task_id, elapsed_ms)

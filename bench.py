@@ -18,10 +18,16 @@ total failure still emits the JSON line — with ``value: null`` and an
 ``error`` field — instead of a stack trace.
 
 Env knobs:
-- ``BENCH_TINY=1``    tiny model config + CPU platform pinned in-process
+- ``BENCH_TINY=1``     tiny model config + CPU platform pinned in-process
   (smoke runs; the real TPU run uses the 270M serving config).
-- ``BENCH_COMPARE=1`` after emitting the headline JSON, also measure the
-  inverted Pallas-kernel configuration and report the delta on stderr.
+- ``BENCH_COMPARE=0``  skip the kernel-on-vs-off comparison (default ON: the
+  headline JSON carries both p50s so the Pallas delta is recorded on
+  hardware every round — BASELINE.json's north star).
+- ``BENCH_COMPARE_TIMEOUT_S`` (900) hard bound on the compare child;
+  ``BENCH_COMPARE_MAX_P50_MS`` (5000) health gate — no compare child is
+  launched if the headline p50 came in above it.
+- ``BENCH_PALLAS=0|1``  force the kernel path off/on in a child process
+  (the orchestrator sets 0 for the compare child); unset → config defaults.
 - ``BENCH_ATTEMPTS`` / ``BENCH_ATTEMPT_TIMEOUT_S`` retry knobs.
 """
 
@@ -43,7 +49,16 @@ BASELINE_P50_MS = 150.0
 # backend is ~100x slower than a chip on the 270M config; the driver's TPU
 # run uses the real model).
 TINY = os.environ.get("BENCH_TINY", "") not in ("", "0")
-COMPARE = os.environ.get("BENCH_COMPARE", "") not in ("", "0")
+COMPARE = os.environ.get("BENCH_COMPARE", "1") not in ("", "0")
+# Never spend the round's number on a comparison: the orchestrator only
+# launches the compare child when the headline p50 came in under this bound
+# (a healthy engine is ~2 orders of magnitude under it), and kills it at
+# BENCH_COMPARE_TIMEOUT_S regardless — the headline JSON is already in hand.
+COMPARE_MAX_P50_MS = float(os.environ.get("BENCH_COMPARE_MAX_P50_MS", "5000"))
+COMPARE_TIMEOUT_S = float(os.environ.get("BENCH_COMPARE_TIMEOUT_S", "900"))
+# Forced kernel selection for a child process ("0"/"1"); unset → config
+# defaults. The orchestrator sets 0 for the compare child.
+FORCE_PALLAS = os.environ.get("BENCH_PALLAS", "")
 
 
 def synth_regions(rng, cfg, n_boxes=100):
@@ -61,8 +76,10 @@ def synth_regions(rng, cfg, n_boxes=100):
     return RegionFeatures(feats, boxes, w, h)
 
 
-# The 8 served task types (config.TASK_REGISTRY), with image counts that
-# exercise buckets 1 and 2 — the shapes real traffic hits.
+# The 8 served task types (config.TASK_REGISTRY). Retrieval runs at 2, 4, 8
+# and 10 candidates so EVERY compiled shape bucket (EngineConfig.image_buckets
+# = 1,2,4,8,10) is warmed and timed — the reference serves 2-10 candidate
+# images (worker.py:278-284).
 ROUND_ROBIN = [
     (1, "what is the man holding", 1),      # VQA
     (15, "is the bowl right of the mug", 1),  # GQA
@@ -71,8 +88,12 @@ ROUND_ROBIN = [
     (16, "q: is it a person? a: no", 1),    # GuessWhat
     (13, "two dogs play in the snow", 1),   # SNLI-VE
     (12, "both images contain two wolves", 2),  # NLVR2
-    (7, "a man riding a horse", 2),         # Retrieval
+    (7, "a man riding a horse", 2),         # Retrieval, bucket 2
+    (7, "a dog catching a frisbee", 4),     # Retrieval, bucket 4
+    (7, "a red car parked outside", 8),     # Retrieval, bucket 8
+    (7, "people waiting for a train", 10),  # Retrieval, bucket 10
 ]
+MAX_IMAGES = max(n for _, _, n in ROUND_ROBIN)
 
 
 def _build_engine(pallas: bool | None):
@@ -97,8 +118,10 @@ def _build_engine(pallas: bool | None):
 
 def _measure(engine, cfg, *, budget_s: float = 45.0):
     """Warm every bucket the round-robin hits, then time it."""
+    from vilbert_multitask_tpu.engine.flops import serving_forward_flops
+
     rng = np.random.default_rng(0)
-    regions = [synth_regions(rng, cfg) for _ in range(2)]
+    regions = [synth_regions(rng, cfg) for _ in range(MAX_IMAGES)]
     reqs = [
         engine.prepare(task_id, q, regions[:n]) for task_id, q, n in ROUND_ROBIN
     ]
@@ -117,23 +140,30 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
     # Scale timed work to the budget so the bench fits on any backend
     # (CPU smoke runs are ~100x slower than the TPU path).
     epochs = max(1, min(8, int(budget_s / max(per_pass_s, 1e-3))))
-    lat_ms, fwd_ms, dec_ms = [], [], []
+    lat_ms, fwd_ms, dec_ms, tflops = [], [], [], []
     for _ in range(epochs):
         for req in reqs:
             t = time.perf_counter()
             engine.run(req)
             lat_ms.append((time.perf_counter() - t) * 1e3)
-            fwd_ms.append(engine.stage_times.get("forward_s", 0.0) * 1e3)
+            fwd_s = engine.stage_times.get("forward_s", 0.0)
+            fwd_ms.append(fwd_s * 1e3)
             dec_ms.append(engine.stage_times.get("decode_s", 0.0) * 1e3)
+            # Achieved FLOP/s for THIS query's compiled bucket (padding rows
+            # count — they're real MXU work the bucketing strategy pays for).
+            flops = serving_forward_flops(cfg.model, cfg.engine, req.bucket)
+            tflops.append(flops / max(fwd_s, 1e-9) / 1e12)
     return {
         "warmup_s": round(warm_s, 1),
         "n_queries": len(lat_ms),
+        "buckets": buckets,
         "p50_ms": round(statistics.median(lat_ms), 3),
         # nearest-rank p95 (ceil), clamped: correct at small sample counts
         "p95_ms": round(sorted(lat_ms)[min(
             len(lat_ms) - 1, math.ceil(0.95 * len(lat_ms)) - 1)], 3),
         "forward_p50_ms": round(statistics.median(fwd_ms), 3),
         "decode_p50_ms": round(statistics.median(dec_ms), 3),
+        "achieved_tflops_p50": round(statistics.median(tflops), 4),
     }
 
 
@@ -148,33 +178,34 @@ def run_measurement() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     t0 = time.perf_counter()
-    cfg, engine = _build_engine(None)
+    forced = {"0": False, "1": True}.get(FORCE_PALLAS)
+    cfg, engine = _build_engine(forced)
     init_s = time.perf_counter() - t0
     print(f"# engine init {init_s:.1f}s; compiling buckets...", file=sys.stderr)
-    pallas_fallback = False
-    if cfg.engine.use_pallas_coattention or cfg.engine.use_pallas_self_attention:
-        # Probe-compile the kernel path on this backend before committing the
-        # measurement to it: if Mosaic rejects the kernel here, degrade to the
-        # XLA attention path rather than losing the round's number.
-        try:
-            engine.warmup(buckets=(1,))
-        except Exception as e:  # noqa: BLE001
-            print(f"# pallas path failed to compile ({e}); falling back to "
-                  f"XLA attention", file=sys.stderr)
-            del engine
-            cfg, engine = _build_engine(False)
-            pallas_fallback = True
+    # No explicit probe needed: every forward funnels through the engine's
+    # own degrade-to-XLA fallback (engine/runtime.py:_call_forward), so the
+    # round's number survives a Mosaic rejection at ANY bucket. Read the
+    # fallback state only after all buckets have compiled.
     stats = _measure(engine, cfg)
+    pallas_fallback = engine.kernel_fallback
+    device_kind = jax.devices()[0].device_kind
     print(
-        f"# device={jax.devices()[0].device_kind} "
-        f"n_queries={stats['n_queries']} p50={stats['p50_ms']}ms "
-        f"p95={stats['p95_ms']}ms forward_p50={stats['forward_p50_ms']}ms "
+        f"# device={device_kind} "
+        f"n_queries={stats['n_queries']} buckets={stats['buckets']} "
+        f"p50={stats['p50_ms']}ms p95={stats['p95_ms']}ms "
+        f"forward_p50={stats['forward_p50_ms']}ms "
         f"decode_p50={stats['decode_p50_ms']}ms init={init_s:.1f}s "
-        f"warmup={stats['warmup_s']}s",
+        f"warmup={stats['warmup_s']}s "
+        f"achieved={stats['achieved_tflops_p50']}TFLOP/s",
         file=sys.stderr,
     )
-    # Headline JSON goes out BEFORE the optional compare pass, so a hung or
-    # crashed compare can never cost the round its number.
+    # MFU against the chip's peak dense bf16 rate (None off-TPU).
+    from vilbert_multitask_tpu.engine.flops import peak_flops_for
+
+    peak = peak_flops_for(device_kind)
+    mfu = (round(stats["achieved_tflops_p50"] * 1e12 / peak, 5)
+           if peak else None)
+
     print(json.dumps({
         "metric": "p50_latency_ms",
         "value": stats["p50_ms"],
@@ -183,25 +214,124 @@ def run_measurement() -> None:
         "p95_ms": stats["p95_ms"],
         "forward_p50_ms": stats["forward_p50_ms"],
         "decode_p50_ms": stats["decode_p50_ms"],
+        "n_queries": stats["n_queries"],
+        "buckets_timed": stats["buckets"],
+        "init_s": round(init_s, 1),
+        "warmup_s": stats["warmup_s"],
+        "achieved_tflops_p50": stats["achieved_tflops_p50"],
+        "mfu": mfu,
         "backend": jax.default_backend(),
-        "device_kind": jax.devices()[0].device_kind,
-        "pallas_coattention": cfg.engine.use_pallas_coattention,
+        "device_kind": device_kind,
+        "pallas_coattention": engine.model.config.use_pallas_coattention,
         **({"pallas_fallback": True} if pallas_fallback else {}),
     }), flush=True)
-    if COMPARE:
-        # Second engine with the kernel knobs inverted; same measurement.
-        # Stderr-only: the headline line above is already emitted.
-        try:
-            default_on = cfg.engine.use_pallas_coattention
-            del engine
-            alt_cfg, other = _build_engine(not default_on)
-            alt = _measure(other, alt_cfg, budget_s=30.0)
-            on_ms = stats["p50_ms"] if default_on else alt["p50_ms"]
-            off_ms = alt["p50_ms"] if default_on else stats["p50_ms"]
-            print(f"# pallas_on={on_ms}ms pallas_off={off_ms}ms",
-                  file=sys.stderr)
-        except Exception as e:  # noqa: BLE001
-            print(f"# compare path failed: {e}", file=sys.stderr)
+
+
+def _run_child(timeout_s: float, extra_env: dict) -> tuple:
+    """Run one measurement child; returns (json_line|None, err_text).
+
+    Child stderr streams through live (compile/warmup liveness lines) while
+    a bounded tail is kept for failure diagnostics. Once the headline JSON
+    is on stdout the measurement is complete — the child exits right after
+    emitting it, so only a short drain wait follows.
+    """
+    import collections
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, **extra_env},
+    )
+    tail: collections.deque = collections.deque(maxlen=40)
+    out_lines: list = []
+    got_json = threading.Event()
+
+    # One dedicated reader per pipe (communicate() would race the stderr
+    # pump for the same fd and lose lines arbitrarily).
+    def _pump_err(stream=proc.stderr, sink=tail):
+        for ln in stream:
+            sys.stderr.write(ln)
+            sink.append(ln.rstrip())
+
+    def _pump_out(stream=proc.stdout, sink=out_lines):
+        for ln in stream:
+            sink.append(ln)
+            if ln.startswith('{"metric"'):
+                got_json.set()
+
+    pumps = [threading.Thread(target=_pump_err, daemon=True),
+             threading.Thread(target=_pump_out, daemon=True)]
+    for t in pumps:
+        t.start()
+    deadline = time.monotonic() + timeout_s
+    timed_out = False
+    while proc.poll() is None:
+        if got_json.is_set():
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                print("# headline JSON in hand; killing lingering child",
+                      file=sys.stderr)
+                proc.kill()
+                proc.wait()
+            break
+        if time.monotonic() >= deadline:
+            timed_out = True
+            proc.kill()
+            proc.wait()
+            break
+        time.sleep(0.5)
+    for t in pumps:
+        t.join(timeout=5)
+    # A line already on stdout is a valid measurement even if the child then
+    # hung or died — never throw away a number in hand.
+    json_line = next(
+        (ln for ln in out_lines if ln.startswith('{"metric"')), None)
+    if json_line:
+        return json_line, ""
+    if timed_out:
+        err = (f"exceeded {timeout_s:.0f}s; last: "
+               f"{tail[-1] if tail else 'no stderr'}")[:400]
+    else:
+        err = f"rc={proc.returncode}: {(tail[-1] if tail else 'no stderr')[:400]}"
+    return None, err
+
+
+def _maybe_compare(headline: dict) -> dict:
+    """Kernel-on-vs-off delta for the headline JSON (BASELINE north star).
+
+    Runs strictly AFTER the headline measurement is in hand, as a separate
+    bounded child — a hung or failed compare can only ever cost itself, never
+    the round's number. Skipped when the headline already fell back to XLA
+    (nothing to compare) or is unhealthy (protect the hardware budget).
+    """
+    if not (COMPARE and headline.get("pallas_coattention")
+            and not headline.get("pallas_fallback")
+            and isinstance(headline.get("value"), (int, float))
+            and headline["value"] < COMPARE_MAX_P50_MS):
+        return headline
+    print("# compare child: XLA-attention engine...", file=sys.stderr)
+    line, err = _run_child(COMPARE_TIMEOUT_S,
+                           {"BENCH_PALLAS": "0", "BENCH_COMPARE": "0"})
+    if line is None:
+        print(f"# compare child failed ({err}); headline unchanged",
+              file=sys.stderr)
+        return headline
+    try:
+        off = json.loads(line)
+        headline = dict(headline)
+        headline["pallas_off_p50_ms"] = off["value"]
+        headline["pallas_off_forward_p50_ms"] = off["forward_p50_ms"]
+        headline["pallas_forward_speedup"] = round(
+            off["forward_p50_ms"] / max(headline["forward_p50_ms"], 1e-9), 3)
+        print(f"# pallas_on={headline['forward_p50_ms']}ms "
+              f"pallas_off={off['forward_p50_ms']}ms (forward p50)",
+              file=sys.stderr)
+    except (ValueError, KeyError) as e:
+        print(f"# compare JSON unusable ({e}); headline unchanged",
+              file=sys.stderr)
+    return headline
 
 
 def main() -> None:
@@ -211,81 +341,22 @@ def main() -> None:
     initialize backend 'axon'` killing the whole bench. Backend-init state
     is process-global in JAX, so each attempt gets a fresh interpreter.
     """
-    import collections
-    import threading
-
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
     timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1800"))
     backoff_s = 30.0
     last_err = "no attempts ran"
     for i in range(1, attempts + 1):
         print(f"# bench attempt {i}/{attempts}", file=sys.stderr)
-        # Child stderr streams through live (compile/warmup liveness lines)
-        # while a bounded tail is kept for the failure diagnostics.
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        tail: collections.deque = collections.deque(maxlen=40)
-        out_lines: list = []
-        got_json = threading.Event()
-
-        # One dedicated reader per pipe (communicate() would race the
-        # stderr pump for the same fd and lose lines arbitrarily).
-        def _pump_err(stream=proc.stderr, sink=tail):
-            for ln in stream:
-                sys.stderr.write(ln)
-                sink.append(ln.rstrip())
-
-        def _pump_out(stream=proc.stdout, sink=out_lines):
-            for ln in stream:
-                sink.append(ln)
-                if ln.startswith('{"metric"'):
-                    got_json.set()
-
-        pumps = [threading.Thread(target=_pump_err, daemon=True),
-                 threading.Thread(target=_pump_out, daemon=True)]
-        for t in pumps:
-            t.start()
-        # Once the headline JSON is on stdout the measurement is complete;
-        # anything after it (the BENCH_COMPARE pass) gets a bounded grace
-        # period instead of the full attempt timeout.
-        grace_s = float(os.environ.get("BENCH_COMPARE_GRACE_S", "900"))
-        deadline = time.monotonic() + timeout_s
-        timed_out = False
-        while proc.poll() is None:
-            if got_json.is_set():
-                if proc.poll() is None:
-                    try:
-                        proc.wait(timeout=grace_s if COMPARE else 10)
-                    except subprocess.TimeoutExpired:
-                        print("# headline JSON in hand; killing lingering "
-                              "child", file=sys.stderr)
-                        proc.kill()
-                        proc.wait()
-                break
-            if time.monotonic() >= deadline:
-                timed_out = True
-                proc.kill()
-                proc.wait()
-                break
-            time.sleep(0.5)
-        for t in pumps:
-            t.join(timeout=5)
-        # A headline line already on stdout is a valid measurement even if
-        # the child then hung or died (e.g. in the BENCH_COMPARE pass) —
-        # never throw away a number in hand.
-        json_line = next(
-            (ln for ln in out_lines if ln.startswith('{"metric"')), None)
+        json_line, err = _run_child(timeout_s, {})
         if json_line:
-            print(json_line, end="" if json_line.endswith("\n") else "\n")
+            try:
+                headline = _maybe_compare(json.loads(json_line))
+                print(json.dumps(headline), flush=True)
+            except ValueError:
+                print(json_line,
+                      end="" if json_line.endswith("\n") else "\n")
             return
-        if timed_out:
-            last_err = (f"attempt {i} exceeded {timeout_s:.0f}s; last: "
-                        f"{tail[-1] if tail else 'no stderr'}")[:400]
-        else:
-            last = tail[-1] if tail else "no stderr"
-            last_err = f"attempt {i} rc={proc.returncode}: {last[:400]}"
+        last_err = f"attempt {i} {err}"
         print(f"# {last_err}", file=sys.stderr)
         if i < attempts:
             time.sleep(backoff_s * i)

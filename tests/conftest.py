@@ -52,6 +52,10 @@ def tiny_framework_cfg(tmp_path_factory):
         engine=EngineConfig(
             max_text_len=12, max_regions=9, num_features=8,
             image_buckets=(1, 2, 4, 8), compute_dtype="float32",
+            # XLA attention here: these fixtures exercise the serving tiers,
+            # not the kernel, and interpret-mode Pallas makes CPU forwards
+            # ~10x slower. Kernel coverage lives in test_pallas_coattention.
+            use_pallas_coattention=False, use_pallas_self_attention=False,
         ),
         serving=ServingConfig(
             queue_db_path=str(root / "queue.sqlite3"),

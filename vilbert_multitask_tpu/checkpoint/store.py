@@ -26,8 +26,14 @@ def save_params(path: str, params: Any) -> None:
 
 
 def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:
-    """Restore a param pytree; with ``mesh``, leaves land already sharded
-    per the partition rules (no replicated staging copy)."""
+    """Restore a param pytree onto the accelerator.
+
+    With ``mesh``, leaves land already sharded per the partition rules (no
+    replicated staging copy); without one, the tree is device_put to the
+    default device — restores are always device-resident, matching the
+    reference's load-once-to-accelerator contract (worker.py:530-536). A
+    host copy is never the steady state.
+    """
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -37,6 +43,8 @@ def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:
         from vilbert_multitask_tpu.parallel import sharding as shd
 
         params = jax.device_put(params, shd.param_shardings(params, mesh))
+    else:
+        params = jax.device_put(params)
     return params
 
 

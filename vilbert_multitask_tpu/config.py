@@ -239,8 +239,13 @@ class EngineConfig:
     image_buckets: Sequence[int] = (1, 2, 4, 8, 10)
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
     param_dtype: str = "float32"
-    use_pallas_coattention: bool = False  # flip on TPU once kernel validated
-    use_pallas_self_attention: bool = False  # 128-aligned streams only
+    # Default ON (round 3): serving runs the flash co-attention kernel on
+    # TPU; bench.py probe-compiles it and degrades to the XLA path if Mosaic
+    # rejects it on the current backend. Off-TPU the kernel runs in
+    # interpreter mode (same numerics, slower) — tests pin whichever path
+    # they mean to exercise.
+    use_pallas_coattention: bool = True
+    use_pallas_self_attention: bool = True  # 128-aligned streams only
     # Text/label assets. None → the committed defaults in assets/ (real
     # file-loading code paths; swap the files for the genuine bert-base-
     # uncased vocab / reference label pickles to get score parity).

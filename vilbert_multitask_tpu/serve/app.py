@@ -36,14 +36,12 @@ class ServeApp:
             max_delivery_attempts=s.max_delivery_attempts)
         self.store = ResultStore(s.results_db_path)
         if engine is None:
-            params = None
-            if checkpoint_path is not None:
-                from vilbert_multitask_tpu.checkpoint import restore_params
-
-                params = restore_params(checkpoint_path)
             # Multi-device host → serve through the dp×tp mesh; a 1-chip box
             # gets plain single-device jit. Same binary either way (the
-            # MeshConfig dp=-1 default absorbs whatever is visible).
+            # MeshConfig dp=-1 default absorbs whatever is visible). The mesh
+            # is built BEFORE the restore so checkpoint leaves land directly
+            # in their sharded placement — no replicated staging copy on one
+            # chip's HBM.
             import jax
 
             mesh = None
@@ -51,6 +49,11 @@ class ServeApp:
                 from vilbert_multitask_tpu.parallel import build_mesh
 
                 mesh = build_mesh(self.cfg.mesh)
+            params = None
+            if checkpoint_path is not None:
+                from vilbert_multitask_tpu.checkpoint import restore_params
+
+                params = restore_params(checkpoint_path, mesh=mesh)
             engine = InferenceEngine(
                 self.cfg, params=params, mesh=mesh,
                 feature_store=FeatureStore(feature_root))

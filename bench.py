@@ -258,6 +258,11 @@ def _run_child(timeout_s: float, extra_env: dict) -> tuple:
         for ln in stream:
             sink.append(ln)
             if ln.startswith('{"metric"'):
+                # Echo the measurement to stderr THE MOMENT it exists:
+                # stdout stays a single (possibly compare-enriched) JSON
+                # line, but if the whole bench is killed mid-compare the
+                # number survives in the stderr record.
+                sys.stderr.write("# headline: " + ln)
                 got_json.set()
 
     pumps = [threading.Thread(target=_pump_err, daemon=True),

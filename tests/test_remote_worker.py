@@ -1,0 +1,133 @@
+"""Remote worker mode: a worker drains the queue over HTTP (VERDICT r2 #6).
+
+The reference's broker is a network service (demo/sender.py:12-15), so web
+tier and GPU worker deploy on separate hosts. These tests stand up the real
+ApiServer over an ephemeral port and drive a real ServeWorker whose queue/
+store/hub are the HTTP shims from serve/remote.py — the full job pipeline
+(claim → intake → forward → persist → push → ack) crossing a real socket.
+"""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vilbert_multitask_tpu.serve import (
+    DurableQueue,
+    PushHub,
+    ResultStore,
+    ServeWorker,
+)
+from vilbert_multitask_tpu.serve.http_api import ApiServer
+from vilbert_multitask_tpu.serve.remote import (
+    RemoteHub,
+    RemoteQueue,
+    RemoteStore,
+    WorkerApiClient,
+    build_remote_worker,
+)
+
+
+@pytest.fixture()
+def web_host(tiny_framework_cfg, tmp_path):
+    """The web-tier half: queue + store + hub behind a live ApiServer."""
+    s = dataclasses.replace(
+        tiny_framework_cfg.serving,
+        queue_db_path=str(tmp_path / "q.sqlite3"),
+        results_db_path=str(tmp_path / "r.sqlite3"),
+        media_root=str(tmp_path / "media"),
+    )
+    hub = PushHub()
+    q = DurableQueue(s.queue_db_path,
+                     max_delivery_attempts=s.max_delivery_attempts)
+    store = ResultStore(s.results_db_path)
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    yield s, hub, q, store, f"http://127.0.0.1:{port}"
+    api.stop()
+
+
+def _submit(base_url, payload):
+    req = urllib.request.Request(
+        base_url + "/", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_remote_worker_drains_queue_over_http(web_host, engine):
+    s, hub, q, store, url = web_host
+    sub = hub.subscribe("sock-remote")
+    out = _submit(url, {"task_id": 1, "socket_id": "sock-remote",
+                        "question": "What is this?",
+                        "image_list": ["img_a"]})
+    assert "job_id" in out
+
+    client = WorkerApiClient(url)
+    worker = ServeWorker(engine, RemoteQueue(client), RemoteStore(client),
+                         RemoteHub(client), s)
+    assert worker.step_batch() == 1
+    assert q.counts() == {}  # acked over HTTP → gone
+
+    frames = []
+    while not sub.empty():
+        frames.append(sub.get_nowait())
+    results = [f for f in frames if "result" in f]
+    assert len(results) == 1
+    assert results[0]["result"]["answers"]
+
+    rows = store.recent()
+    assert len(rows) == 1 and rows[0]["answer_text"]["answers"]
+
+
+def test_remote_worker_failure_nacks_to_dead_letter(web_host, engine):
+    s, hub, q, store, url = web_host
+    # Unknown feature key → intake raises on the worker, every redelivery,
+    # until the job dead-letters — all over HTTP.
+    _submit(url, {"task_id": 1, "socket_id": "sock-x",
+                  "question": "what", "image_list": ["missing_key"]})
+    client = WorkerApiClient(url)
+    worker = ServeWorker(engine, RemoteQueue(client), RemoteStore(client),
+                         RemoteHub(client), s)
+    for _ in range(s.max_delivery_attempts + 1):
+        worker.step_batch()
+    assert q.counts().get("dead", 0) == 1
+
+
+def test_worker_endpoints_reject_bad_token(tiny_framework_cfg, tmp_path):
+    s = dataclasses.replace(
+        tiny_framework_cfg.serving,
+        queue_db_path=str(tmp_path / "q.sqlite3"),
+        results_db_path=str(tmp_path / "r.sqlite3"),
+        worker_token="sekrit",
+    )
+    hub = PushHub()
+    q = DurableQueue(s.queue_db_path)
+    store = ResultStore(s.results_db_path)
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            WorkerApiClient(url).post("/worker/claim", {})
+        assert ei.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            WorkerApiClient(url, token="wrong").post("/worker/claim", {})
+        assert ei.value.code == 401
+        out = WorkerApiClient(url, token="sekrit").post("/worker/claim", {})
+        assert out == {"job": None}
+        # Public endpoints stay open: job submission is the browser surface.
+        resp = _submit(url, {"task_id": 1, "socket_id": "s",
+                             "question": "q", "image_list": ["img_a"]})
+        assert "job_id" in resp
+    finally:
+        api.stop()
+
+
+def test_build_remote_worker_reuses_engine(web_host, engine):
+    _, _, _, _, url = web_host
+    w = build_remote_worker(url, engine=engine)
+    assert w.engine is engine
+    assert isinstance(w.queue, RemoteQueue)

@@ -285,6 +285,10 @@ class ServingConfig:
     max_upload_images: int = 10
     max_delivery_attempts: int = 3  # poison-message bound (fixes worker.py:650-655)
     lowercase_questions: bool = True  # reference lowercases server-side (views.py:27)
+    # Shared secret for the /worker/* endpoints (remote workers, serve/remote.py).
+    # None → open, matching the reference broker's default-credentials posture
+    # (sender.py:12-15); set it when workers cross host boundaries.
+    worker_token: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)

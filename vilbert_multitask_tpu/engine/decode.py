@@ -73,13 +73,25 @@ def decode_labels(
     spec: TaskSpec, logits_row: np.ndarray, labels: LabelMapStore
 ) -> TaskResult:
     """VQA/GQA: softmax over the answer vocabulary, top-k answers."""
-    vocab = labels.get(spec.label_map)
     probs = softmax(np.asarray(logits_row, np.float32))
     order = np.argsort(-probs)[: spec.top_k]
+    return decode_labels_topk(spec, order, probs[order], labels)
+
+
+def decode_labels_topk(
+    spec: TaskSpec, top_idx: np.ndarray, top_probs: np.ndarray,
+    labels: LabelMapStore,
+) -> TaskResult:
+    """VQA/GQA from an already-reduced top-k — the serving path, where the
+    softmax + top-k ran on device inside the jitted forward
+    (engine/runtime.py:_decode_bundle) so only k (index, prob) pairs cross
+    the device→host link instead of the 3129/1533-wide head row."""
+    vocab = labels.get(spec.label_map)
     answers = [
         {"answer": vocab[i] if i < len(vocab) else f"<{i}>",
-         "confidence": float(probs[i])}
-        for i in order
+         "confidence": float(p)}
+        for i, p in zip(np.asarray(top_idx)[: spec.top_k],
+                        np.asarray(top_probs)[: spec.top_k])
     ]
     return TaskResult(spec.task_id, "labels", answers=answers)
 

@@ -244,8 +244,65 @@ class ApiServer:
                     self._json(*api.submit_job(payload))
                 elif path == "/upload_image":
                     self._handle_upload(raw, ctype)
+                elif path.startswith("/worker/"):
+                    self._handle_worker(path, raw)
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _handle_worker(self, path: str, raw: bytes):
+                """Network face of the queue/store/hub for remote workers
+                (serve/remote.py) — the reference's broker is reachable over
+                TCP (demo/sender.py:12-15); this keeps web tier and TPU
+                workers deployable on separate hosts."""
+                token = getattr(api.serving, "worker_token", None)
+                if token:
+                    import hmac
+
+                    auth = self.headers.get("Authorization", "")
+                    if not hmac.compare_digest(auth, f"Bearer {token}"):
+                        self._json(401, {"error": "bad worker token"})
+                        return
+                try:
+                    p = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    self._json(400, {"error": "invalid JSON"})
+                    return
+                try:
+                    if path == "/worker/claim":
+                        job = api.queue.claim(
+                            exclude=[int(x) for x in p.get("exclude", [])])
+                        self._json(200, {"job": None if job is None else {
+                            "id": job.id, "body": job.body,
+                            "attempts": job.attempts}})
+                    elif path == "/worker/ack":
+                        api.queue.ack(int(p["job_id"]))
+                        self._json(200, {"ok": True})
+                    elif path == "/worker/nack":
+                        self._json(200,
+                                   {"status": api.queue.nack(int(p["job_id"]))})
+                    elif path == "/worker/release":
+                        api.queue.release(int(p["job_id"]))
+                        self._json(200, {"ok": True})
+                    elif path == "/worker/question":
+                        qa_id = api.store.create_question(
+                            int(p["task_id"]), str(p.get("input_text", "")),
+                            list(p.get("input_images", [])),
+                            str(p.get("socket_id", "")),
+                            queue_job_id=p.get("queue_job_id"))
+                        self._json(200, {"qa_id": qa_id})
+                    elif path == "/worker/answer":
+                        api.store.save_answer(
+                            int(p["qa_id"]), p.get("answer", {}),
+                            list(p.get("answer_images", [])))
+                        self._json(200, {"ok": True})
+                    elif path == "/worker/push":
+                        n = api.hub.publish(str(p.get("socket_id", "")),
+                                            p.get("frame", {}))
+                        self._json(200, {"subscribers": n})
+                    else:
+                        self._json(404, {"error": "not found"})
+                except (KeyError, TypeError, ValueError) as e:
+                    self._json(400, {"error": f"bad worker request: {e}"})
 
             def _handle_upload(self, raw: bytes, ctype: str):
                 if "multipart/form-data" not in ctype:

@@ -1,0 +1,232 @@
+"""Remote worker mode: drain the job queue over HTTP from another host.
+
+Reference capability: the broker is a *network* service (demo/sender.py:12-15
+connects to RabbitMQ over TCP; the Django web tier and the GPU worker are
+separate processes on separate boxes, worker.py:661-676). The TPU build's
+durable queue is an embedded sqlite file on the web host — this module gives
+it the network face: a worker anywhere reaches the web host's ``/worker/*``
+endpoints (serve/http_api.py) to claim jobs, record audit rows, save answers
+and push websocket frames, while inference runs on the worker's own chips.
+
+Design: :class:`ServeWorker` already talks to exactly three collaborators —
+queue (claim/ack/nack), store (create_question/save_answer), hub (publish).
+The remote mode implements those three interfaces as thin HTTP shims, so the
+entire job pipeline (intake, micro-batching, failure handling, rendering) is
+the SAME code serving locally and remotely — no second worker implementation
+to drift.
+
+Caveat (documented in ARCHITECTURE.md): grounding-box rendering reads the
+source image from local disk; on a worker host without the media volume the
+render step degrades gracefully (no result_images), exactly like the local
+path when an image file is missing.
+
+Run: ``python -m vilbert_multitask_tpu.serve.remote --url http://web:8400``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+from vilbert_multitask_tpu.serve.queue import Job
+
+log = logging.getLogger(__name__)
+
+# Transient transport failures worth retrying (web-host restart, TCP blip).
+_NET_ERRORS = (urllib.error.URLError, ConnectionError, TimeoutError, OSError)
+
+
+class WorkerApiClient:
+    """JSON-over-HTTP client for the web host's ``/worker/*`` endpoints.
+
+    Network errors retry with exponential backoff — a web-host restart or a
+    TCP blip must not kill a TPU worker that took minutes to warm up. HTTP
+    *status* errors (401 bad token, 400 bad request) do NOT retry: they are
+    deterministic and the caller needs to see them.
+    """
+
+    def __init__(self, base_url: str, *, token: Optional[str] = None,
+                 timeout_s: float = 30.0, retries: int = 5,
+                 backoff_s: float = 0.5):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.backoff_s = backoff_s
+
+    def post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            req = urllib.request.Request(
+                self.base_url + path,
+                data=json.dumps(payload).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    **({"Authorization": f"Bearer {self.token}"}
+                       if self.token else {}),
+                },
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError:
+                raise  # deterministic: bad token / bad request
+            except _NET_ERRORS as e:
+                last = e
+                if attempt < self.retries - 1:
+                    delay = self.backoff_s * (2 ** attempt)
+                    log.warning("POST %s failed (%s); retry in %.1fs",
+                                path, e, delay)
+                    time.sleep(delay)
+        raise last  # type: ignore[misc]
+
+
+class RemoteQueue:
+    """DurableQueue's consumer interface over HTTP (claim/ack/nack/release).
+
+    Failure posture follows at-least-once delivery: a claim that can't reach
+    the web host reports "queue drained" (the loop sleeps and retries); a
+    lost ack/nack is swallowed with a warning — the visibility timeout
+    redelivers the job, which is the same guarantee the local sqlite queue
+    gives a worker that crashes between claim and ack."""
+
+    def __init__(self, client: WorkerApiClient):
+        self._c = client
+
+    def claim(self, exclude: Sequence[int] = ()) -> Optional[Job]:
+        try:
+            out = self._c.post("/worker/claim", {"exclude": list(exclude)})
+        except _NET_ERRORS as e:
+            log.warning("claim unreachable (%s); treating as drained", e)
+            return None
+        j = out.get("job")
+        if j is None:
+            return None
+        return Job(id=int(j["id"]), body=j["body"],
+                   attempts=int(j["attempts"]))
+
+    def ack(self, job_id: int) -> None:
+        try:
+            self._c.post("/worker/ack", {"job_id": job_id})
+        except _NET_ERRORS as e:
+            log.warning("ack(%d) lost (%s); job will redeliver", job_id, e)
+
+    def nack(self, job_id: int) -> str:
+        try:
+            return self._c.post("/worker/nack", {"job_id": job_id}).get(
+                "status", "gone")
+        except _NET_ERRORS as e:
+            log.warning("nack(%d) lost (%s); visibility timeout will "
+                        "requeue", job_id, e)
+            return "gone"
+
+    def release(self, job_id: int) -> None:
+        try:
+            self._c.post("/worker/release", {"job_id": job_id})
+        except _NET_ERRORS as e:
+            log.warning("release(%d) lost (%s)", job_id, e)
+
+
+class RemoteStore:
+    """ResultStore's worker-side interface over HTTP."""
+
+    def __init__(self, client: WorkerApiClient):
+        self._c = client
+
+    def create_question(self, task_id: int, input_text: str,
+                        input_images: List[str], socket_id: str,
+                        queue_job_id: Optional[int] = None) -> int:
+        out = self._c.post("/worker/question", {
+            "task_id": task_id, "input_text": input_text,
+            "input_images": list(input_images), "socket_id": socket_id,
+            "queue_job_id": queue_job_id,
+        })
+        return int(out["qa_id"])
+
+    def save_answer(self, qa_id: int, answer: Dict[str, Any],
+                    answer_images: Optional[List[str]] = None) -> None:
+        self._c.post("/worker/answer", {
+            "qa_id": qa_id, "answer": answer,
+            "answer_images": answer_images or [],
+        })
+
+
+class RemoteHub:
+    """PushHub's publish interface over HTTP — frames fan out to the web
+    host's websocket clients. Best-effort like the local hub: a dead web
+    host must not crash the job cycle (the queue redelivers on nack)."""
+
+    def __init__(self, client: WorkerApiClient):
+        self._c = client
+
+    def publish(self, socket_id: str, payload: Dict[str, Any]) -> int:
+        try:
+            out = self._c.post("/worker/push",
+                               {"socket_id": socket_id, "frame": payload})
+            return int(out.get("subscribers", 0))
+        except (urllib.error.URLError, OSError, ValueError):
+            return 0
+
+
+def build_remote_worker(base_url: str, *, cfg=None, engine=None,
+                        feature_root: str = "features",
+                        checkpoint_path: Optional[str] = None,
+                        token: Optional[str] = None):
+    """A ServeWorker whose queue/store/hub live on ``base_url``."""
+    from vilbert_multitask_tpu.config import FrameworkConfig
+    from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.serve.worker import ServeWorker
+
+    cfg = cfg or FrameworkConfig()
+    client = WorkerApiClient(base_url, token=token)
+    if engine is None:
+        params = None
+        if checkpoint_path is not None:
+            from vilbert_multitask_tpu.checkpoint import restore_params
+
+            params = restore_params(checkpoint_path)
+        engine = InferenceEngine(cfg, params=params,
+                                 feature_store=FeatureStore(feature_root))
+    return ServeWorker(engine, RemoteQueue(client), RemoteStore(client),
+                       RemoteHub(client), cfg.serving)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="ViLBERT multi-task remote TPU worker")
+    p.add_argument("--url", required=True,
+                   help="web host base URL, e.g. http://web:8400")
+    p.add_argument("--features", default="features")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--token", default=None,
+                   help="bearer token if the web host sets worker_token")
+    p.add_argument("--poll", type=float, default=0.5,
+                   help="idle poll interval (s); remote claims are HTTP "
+                        "requests, so idle polling is throttled vs the "
+                        "local worker's 0.05s sqlite poll")
+    p.add_argument("--no-warmup", action="store_true")
+    args = p.parse_args(argv)
+
+    worker = build_remote_worker(
+        args.url, feature_root=args.features,
+        checkpoint_path=args.checkpoint, token=args.token)
+    if args.checkpoint is None:
+        print("WARNING: no --checkpoint given; serving randomly initialized "
+              "weights (answers will be meaningless)")
+    if not args.no_warmup:
+        print("warming shape buckets...")
+        worker.engine.warmup()
+    print(f"draining {args.url} ...")
+    worker.run_forever(poll_interval_s=args.poll)
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,26 @@ def test_warmup_falls_back_to_xla_when_kernel_rejected(tiny_config,
     eng.warmup(buckets=(1, 2))  # further compiles stay on the XLA path
 
 
+def test_vocab_overflow_fails_at_boot(tiny_config, caplog):
+    """VERDICT r2 #7: a vocab bigger than the embedding table must fail at
+    boot (on TPU an OOB gather clamps silently); a much-wider table warns."""
+    import logging
+
+    # Overflow: table with fewer rows than the committed 1,037-token vocab.
+    # (The check runs before param init, so the failure is immediate.)
+    small = dataclasses.replace(tiny_config, vocab_size=512)
+    with pytest.raises(ValueError, match="index out of the embedding"):
+        InferenceEngine(FrameworkConfig(
+            model=small, engine=_cpu_engine_cfg(max_regions=11)))
+    # Dead-weight gap: big table over the small vocab → warning, not error.
+    # params={} skips the (slow, irrelevant) random init compile.
+    wide = dataclasses.replace(tiny_config, vocab_size=30522)
+    with caplog.at_level(logging.WARNING):
+        InferenceEngine(FrameworkConfig(
+            model=wide, engine=_cpu_engine_cfg(max_regions=11)), params={})
+    assert any("dead weight" in r.message for r in caplog.records)
+
+
 def test_engine_defaults_to_committed_assets(engine):
     """No tokenizer/label args → the committed vocab + reference-layout
     pickles load by default (never the in-memory demo vocab)."""

@@ -251,6 +251,13 @@ class EngineConfig:
     # uncased vocab / reference label pickles to get score parity).
     vocab_path: str | None = None
     labels_root: str | None = None
+    # Persistent XLA compilation cache (process-global when set): serving
+    # restarts and bench attempts skip the ~15s/bucket compile after the
+    # first boot on a given chip generation. None → JAX default (off).
+    compilation_cache_dir: str | None = None
+    # Compile shape buckets concurrently at warmup — XLA compilation is C++
+    # and releases the GIL, so 5 buckets warm in ~the longest single compile.
+    parallel_warmup: bool = True
 
     def bucket_for(self, n_images: int) -> int:
         for b in self.image_buckets:

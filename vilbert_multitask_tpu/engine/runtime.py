@@ -23,6 +23,7 @@ model:
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -50,6 +51,24 @@ from vilbert_multitask_tpu.parallel import sharding as shd
 from vilbert_multitask_tpu import assets
 from vilbert_multitask_tpu.text.pipeline import EncodedText, encode_question
 from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+
+
+_cache_enabled_for: Optional[str] = None
+
+
+def _enable_compilation_cache(path: str) -> None:
+    """Turn on JAX's persistent compilation cache (process-global, so set
+    once; a second engine with a different path keeps the first's — JAX has
+    one cache per process)."""
+    global _cache_enabled_for
+    if _cache_enabled_for is not None:
+        return
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    _cache_enabled_for = path
 
 
 @dataclasses.dataclass
@@ -96,6 +115,7 @@ class InferenceEngine:
         # paths (reference worker.py:537-539, 299-315), not in-memory toys.
         self.tokenizer = tokenizer or FullTokenizer.from_vocab_file(
             ecfg.vocab_path or assets.default_vocab_path())
+        self._check_vocab_coherence()
         self.feature_store = feature_store
         self.labels = label_store or LabelMapStore(
             root=ecfg.labels_root or assets.default_labels_root(),
@@ -103,6 +123,8 @@ class InferenceEngine:
                    "gqa": self.cfg.model.gqa_num_labels}
         )
         self.mesh = mesh
+        if ecfg.compilation_cache_dir:
+            _enable_compilation_cache(ecfg.compilation_cache_dir)
         if params is None:
             params = self.init_params(jax.random.PRNGKey(seed))
         if mesh is not None:
@@ -120,11 +142,45 @@ class InferenceEngine:
         self.params = params
         self._compiled: Dict[Tuple[int, bool], callable] = {}
         self.stage_times: Dict[str, float] = {}
-        # Set by warmup() if Mosaic rejected the Pallas kernels on this
-        # backend and the engine degraded itself to the XLA attention path.
+        # Set by the first forward if Mosaic rejected the Pallas kernels on
+        # this backend and the engine degraded to the XLA attention path.
+        # _model_gen increments on degrade; the compile cache is keyed by it
+        # so a closure built against the pre-degrade model can never be
+        # served to a post-degrade call (parallel-warmup race).
         self.kernel_fallback = False
+        self._model_gen = 0
+        self._fallback_lock = threading.Lock()
 
     # ------------------------------------------------------------------ init
+    def _check_vocab_coherence(self) -> None:
+        """Boot-time guard: the loaded vocab must fit the embedding table.
+
+        A vocab larger than ``vocab_size`` would emit token ids that index
+        out of the embedding table — on TPU that's a silent gather clamp,
+        not an error, so every over-range token would quietly read row
+        vocab_size-1. Fail loudly here instead. The inverse gap (table much
+        wider than the vocab, e.g. the 30,522-row serving table over the
+        committed 1,037-token synthetic vocab) is legal but worth a log
+        line: those rows are dead weight until the real vocab is swapped in
+        (config.py EngineConfig.vocab_path).
+        """
+        n_vocab = len(self.tokenizer.vocab)
+        n_rows = self.cfg.model.vocab_size
+        if n_vocab > n_rows:
+            raise ValueError(
+                f"vocab file has {n_vocab} tokens but ViLBertConfig."
+                f"vocab_size is {n_rows}: token ids would index out of the "
+                f"embedding table. Fix vocab_path or vocab_size.")
+        if n_rows > 2 * n_vocab:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "embedding table has %d rows but the vocab only %d tokens "
+                "(%.0f%% dead weight) — expected with the committed "
+                "synthetic vocab; swap EngineConfig.vocab_path to the real "
+                "bert-base-uncased vocab for score parity",
+                n_rows, n_vocab, 100 * (1 - n_vocab / n_rows))
+
     def _dummy_batch(self, batch: int):
         ecfg, mcfg = self.cfg.engine, self.cfg.model
         return dict(
@@ -209,7 +265,7 @@ class InferenceEngine:
         }
 
     def _forward(self, bucket: int, collect_attention: bool):
-        key = (bucket, collect_attention)
+        key = (bucket, collect_attention, self._model_gen)
         if key not in self._compiled:
             model = self.model
 
@@ -268,7 +324,8 @@ class InferenceEngine:
                 use_pallas_coattention=False,
                 use_pallas_self_attention=False),
             dtype=self.compute_dtype)
-        self._compiled.clear()
+        self._model_gen += 1
+        self._compiled.clear()  # memory hygiene; staleness is keyed out
 
     def _call_forward(self, bucket: int, collect_attention: bool, batch):
         """All device forwards funnel through here — it's the Pallas probe.
@@ -280,22 +337,59 @@ class InferenceEngine:
         evals, bench, and un-warmed engines whose first compile happens on a
         live request). A second failure propagates: it isn't the kernel.
         """
+        gen_before = self._model_gen
         try:
             return self._forward(bucket, collect_attention)(self.params, batch)
         except Exception as e:  # noqa: BLE001 — compile-time rejection
-            self._degrade_to_xla(e)  # re-raises unless kernels were on
+            with self._fallback_lock:
+                # Parallel warmup: several buckets can hit the rejection at
+                # once; the first thread degrades, the rest just retry on
+                # the already-rebuilt XLA model.
+                if not self.kernel_fallback:
+                    self._degrade_to_xla(e)  # re-raises unless kernel's fault
+            if self._model_gen == gen_before:
+                # No degrade happened during this call — the engine was
+                # already on the XLA path, so this is a genuine runtime
+                # error; re-running the forward would double device work
+                # exactly when the device is struggling.
+                raise
             return self._forward(bucket, collect_attention)(self.params, batch)
 
-    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
-        """Pre-compile every shape bucket so first requests pay no compile."""
-        for b in buckets or self.cfg.engine.image_buckets:
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               parallel: Optional[bool] = None) -> None:
+        """Pre-compile every shape bucket so first requests pay no compile.
+
+        With ``parallel`` (default from EngineConfig), buckets compile
+        concurrently: XLA compilation is C++ and releases the GIL, so the
+        full bucket set warms in roughly the longest single compile instead
+        of the sum — the difference between a ~70s and a ~20s boot on a
+        v5e. Kernel-rejection fallback stays correct under concurrency
+        (the first failing thread degrades under a lock; others retry on
+        the rebuilt XLA model).
+        """
+        buckets = list(buckets or self.cfg.engine.image_buckets)
+        if parallel is None:
+            parallel = self.cfg.engine.parallel_warmup
+
+        def _warm_one(b: int) -> None:
             batch = self._dummy_batch(b)
             if self.mesh is not None:
                 # Match run()'s input shardings exactly — a different input
                 # sharding is a different XLA program (fresh compile).
-                batch = jax.device_put(batch, shd.batch_shardings(batch, self.mesh))
+                batch = jax.device_put(batch,
+                                       shd.batch_shardings(batch, self.mesh))
             _, bundle = self._call_forward(b, False, batch)
             jax.block_until_ready(bundle["vil_logit"])
+
+        if parallel and len(buckets) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=len(buckets)) as pool:
+                # list() propagates the first worker exception to the caller.
+                list(pool.map(_warm_one, buckets))
+        else:
+            for b in buckets:
+                _warm_one(b)
 
     # -------------------------------------------------------------- prepare
     def prepare(

@@ -44,12 +44,16 @@ class ApiServer:
         hub: PushHub,
         serving: Optional[ServingConfig] = None,
         metrics=None,
+        boot_info: Optional[Dict[str, Any]] = None,
     ):
         self.queue = queue
         self.store = store
         self.hub = hub
         self.serving = serving or ServingConfig()
         self.metrics = metrics
+        # Live reference filled in by ServeApp as boot stages finish
+        # (engine init / warmup timings, kernel path) — surfaced in /healthz.
+        self.boot_info = boot_info if boot_info is not None else {}
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -76,10 +80,14 @@ class ApiServer:
             question = question.lower()  # reference views.py:27
         log_to_terminal(self.hub, socket_id,
                         {"info": f"Starting {spec.name} job..."})
+        collect = payload.get("collect_attention", False)
         job_id = self.queue.publish(
             make_job_message(
                 images, question, task_id, socket_id,
-                collect_attention=bool(payload.get("collect_attention"))))
+                # "full" passes through (complete per-head maps persisted);
+                # any other truthy value → compact summary.
+                collect_attention=("full" if collect == "full"
+                                   else bool(collect))))
         return 200, {"job_id": job_id, "task": spec.name}
 
     def task_details(self, task_id: int) -> Tuple[int, Dict[str, Any]]:
@@ -185,8 +193,11 @@ class ApiServer:
                     for r in rows:
                         r.pop("socket_id", None)
                     self._json(200, {"rows": rows})
+                elif path.startswith("/attention/"):
+                    self._serve_attention(path)
                 elif path == "/healthz":
-                    self._json(200, {"ok": True, "queue": api.queue.counts()})
+                    self._json(200, {"ok": True, "queue": api.queue.counts(),
+                                     "boot": api.boot_info})
                 elif path == "/metrics":
                     snap = (api.metrics.snapshot()
                             if api.metrics is not None else {})
@@ -209,6 +220,54 @@ class ApiServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _serve_attention(self, path: str):
+                """JSON view of a request's persisted full attention maps
+                (worker.save_full_attention). Default response is head-
+                averaged per bridge — browser-heatmap sized; ``?heads=all``
+                returns every head (the complete reference-contract payload,
+                worker.py:288). The raw arrays are also downloadable as
+                ``/media/attention/qa_<id>.npz``."""
+                from urllib.parse import parse_qs, urlsplit
+
+                try:
+                    qa_id = int(urlsplit(path).path.split("/")[2])
+                except (IndexError, ValueError):
+                    self._json(400, {"error": "bad qa id"})
+                    return
+                npz = os.path.join(api.serving.media_root, "attention",
+                                   f"qa_{qa_id}.npz")
+                if not os.path.isfile(npz):
+                    self._json(404, {"error": f"no attention maps for "
+                                              f"qa {qa_id}; submit with "
+                                              f"collect_attention='full'"})
+                    return
+                import numpy as np
+
+                all_heads = parse_qs(urlsplit(self.path).query).get(
+                    "heads", [""])[0] == "all"
+                try:
+                    with np.load(npz) as z:
+                        bridges: Dict[int, Dict[str, Any]] = {}
+                        for key in z.files:
+                            name, direction = key.rsplit("_", 1)
+                            idx = int(name.replace("bridge", ""))
+                            arr = z[key]  # (H, Nq, Nk)
+                            if not all_heads:
+                                arr = arr.mean(axis=0)  # head-avg (Nq, Nk)
+                            bridges.setdefault(idx, {})[direction] = (
+                                np.round(arr, 5).tolist())
+                except Exception as e:  # noqa: BLE001 — a corrupt archive
+                    # (zipfile.BadZipFile, truncated stream) must yield a
+                    # JSON 500, not a dropped connection.
+                    self._json(500, {"error": f"attention maps for qa "
+                                              f"{qa_id} unreadable: {e}"})
+                    return
+                self._json(200, {
+                    "qa_id": qa_id,
+                    "heads": "all" if all_heads else "mean",
+                    "bridges": [bridges[i] for i in sorted(bridges)],
+                })
 
             def _serve_media(self):
                 rel = self.path[len("/media/"):].lstrip("/")

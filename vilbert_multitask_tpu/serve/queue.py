@@ -180,14 +180,18 @@ class DurableQueue:
 
 def make_job_message(image_paths, question: str, task_id: int,
                      socket_id: str, *,
-                     collect_attention: bool = False) -> Dict[str, Any]:
+                     collect_attention: "bool | str" = False
+                     ) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
     list of absolute paths, ``question`` the (pre-lowercased) query.
 
     ``collect_attention`` extends the schema: the reference requests
     per-layer attention maps on every forward (worker.py:288,
     ``output_all_attention_masks=True``) but never surfaces them; here the
-    maps are opt-in per job and a summary rides back in the result payload.
+    maps are opt-in per job — truthy returns the [CLS]→regions summary in
+    the result payload; the string ``"full"`` additionally persists every
+    per-bridge per-head map, retrievable via ``/attention/<qa_id>`` and as
+    a downloadable ``.npz``.
     """
     msg = {
         "image_path": list(image_paths),
@@ -196,5 +200,5 @@ def make_job_message(image_paths, question: str, task_id: int,
         "socket_id": socket_id,
     }
     if collect_attention:
-        msg["collect_attention"] = True
+        msg["collect_attention"] = collect_attention
     return msg

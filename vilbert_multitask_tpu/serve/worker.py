@@ -50,6 +50,41 @@ def _attention_summary(out) -> Dict[str, Any]:
             "n_bridges": len(bridges)}
 
 
+def save_full_attention(out, qa_id: int, media_root: str) -> Dict[str, Any]:
+    """Persist the COMPLETE per-bridge co-attention maps for one request.
+
+    Both directions of every bridge, all heads, request row 0 —
+    ``bridge{i}_t2v`` (H, Nt, Nv) and ``bridge{i}_v2t`` (H, Nv, Nt) — as a
+    compressed ``.npz`` under ``media/attention/``. The reference's
+    ``output_all_attention_masks=True`` contract (worker.py:288) made these
+    maps exist on every forward and then dropped them; here a job opting in
+    with ``collect_attention="full"`` gets the whole payload back through
+    the API: the npz is downloadable at ``/media/attention/qa_<id>.npz`` and
+    ``GET /attention/<qa_id>`` serves a JSON view for the browser.
+    """
+    import numpy as np
+
+    arrays: Dict[str, Any] = {}
+    for i, (probs_t2v, probs_v2t) in enumerate(out.attn_data_list):
+        if probs_t2v is not None:
+            arrays[f"bridge{i}_t2v"] = np.asarray(probs_t2v, np.float32)[0]
+        if probs_v2t is not None:
+            arrays[f"bridge{i}_v2t"] = np.asarray(probs_v2t, np.float32)[0]
+    out_dir = os.path.join(media_root, "attention")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"qa_{qa_id}.npz")
+    # Write-then-rename: a worker killed mid-write must never leave a
+    # truncated npz at the final path (every later GET would 500). The tmp
+    # name keeps the .npz suffix — np.savez appends one otherwise and the
+    # rename source would not exist.
+    tmp = os.path.join(out_dir, f".qa_{qa_id}.tmp.npz")
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+    return {"qa_id": qa_id,
+            "full_map_npz": f"/media/attention/qa_{qa_id}.npz",
+            "full_map_url": f"/attention/{qa_id}"}
+
+
 class ServeWorker:
     """Single-process inference worker (one engine, one queue consumer)."""
 
@@ -99,9 +134,18 @@ class ServeWorker:
     def process_job(self, job: Job) -> Dict[str, Any]:
         """One message end-to-end; raises on failure (caller nacks)."""
         qa_id, prepared, t0 = self._intake(job)
-        collect = bool(job.body.get("collect_attention", False))
-        out, result = self.engine.run(prepared, collect_attention=collect)
-        attention = _attention_summary(out) if collect else None
+        # collect_attention: falsy → none; truthy → summary in the result
+        # frame; the string "full" additionally persists every per-bridge
+        # per-head map (save_full_attention).
+        collect = job.body.get("collect_attention", False)
+        out, result = self.engine.run(prepared,
+                                      collect_attention=bool(collect))
+        attention = None
+        if collect:
+            attention = _attention_summary(out)
+            if collect == "full":
+                attention.update(save_full_attention(
+                    out, qa_id, self.serving.media_root))
         return self._finish_job(job, qa_id, prepared, result, t0,
                                 attention=attention)
 

@@ -119,6 +119,60 @@ def test_self_attention_pallas_matches_xla(rng):
                                atol=2e-5, rtol=2e-5)
 
 
+def test_self_attention_kernel_at_visual_stream_geometry(rng):
+    """The EXACT serving eligibility claim (config.py): the 1024-wide/8-head
+    visual stream (head_dim 128) takes the kernel path at its real length
+    (101 regions) and matches XLA; BERT-base text (768/12, head_dim 64)
+    must NOT take it (a 64-lane op would waste half the MXU)."""
+    from vilbert_multitask_tpu.ops.attention import FusedSelfAttention
+
+    nrng = np.random.default_rng(11)
+    B, N, H, heads = 2, 101, 1024, 8  # visual stream, serving geometry
+    x = jnp.asarray(nrng.normal(size=(B, N, H)), jnp.float32)
+    mask = jnp.ones((B, N), jnp.int32).at[:, 77:].set(0)
+    bias = mask_to_bias(mask)
+    mod_x = FusedSelfAttention(hidden_size=H, num_heads=heads,
+                               use_pallas=False)
+    mod_p = FusedSelfAttention(hidden_size=H, num_heads=heads,
+                               use_pallas=True)
+    params = mod_x.init(rng, x, bias)["params"]
+    ref, _ = mod_x.apply({"params": params}, x, bias)
+    out, probs = mod_p.apply({"params": params}, x, bias)
+    assert probs is None  # proof the kernel path actually ran
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    # Text-stream geometry: head_dim 64 → kernel ineligible, probs returned.
+    Ht, ht_heads, Nt = 768, 12, 38
+    xt = jnp.asarray(nrng.normal(size=(1, Nt, Ht)), jnp.float32)
+    bt = mask_to_bias(jnp.ones((1, Nt), jnp.int32))
+    mod_t = FusedSelfAttention(hidden_size=Ht, num_heads=ht_heads,
+                               use_pallas=True)
+    pt = mod_t.init(rng, xt, bt)["params"]
+    _, probs_t = mod_t.apply({"params": pt}, xt, bt)
+    assert probs_t is not None  # stayed on XLA as designed
+
+
+def test_mosaic_compiles_kernel_on_tpu():
+    """TPU-only (skips on the CPU-pinned test backend): the kernel must
+    COMPILE under Mosaic — interpret=False — and match XLA at the serving
+    geometry. bench.py exercises this on hardware every round
+    (BENCH_r03: pallas_coattention=true); this pins it as a test artifact
+    wherever a chip is visible."""
+    import pytest
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a real TPU backend (Mosaic)")
+    rng = np.random.default_rng(0)
+    B, Nq, Nk, H, D = 2, 38, 101, 8, 128
+    q, k, v = _rand_qkv(rng, B, Nq, Nk, H, D)
+    bias = mask_to_bias(jnp.ones((B, Nk), jnp.int32))
+    ref, _ = multi_head_attention(q, k, v, bias)
+    out = flash_cross_attention(q, k, v, bias, interpret=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)  # bf16-class tolerance
+
+
 def test_pretraining_heads_skippable(tiny_config, rng):
     """compute_pretraining_heads=False drops only the masked-modeling heads."""
     model = ViLBertForVLTasks(tiny_config, dtype=jnp.float32)

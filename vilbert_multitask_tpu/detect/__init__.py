@@ -1,0 +1,14 @@
+"""Live region-feature extraction: the JAX/Pallas-era Faster R-CNN.
+
+Reference capability: ``FeatureExtractor`` (reference worker.py:59-223),
+which drives the maskrcnn_benchmark X-152-32x8d-FPN C++/CUDA stack. Serving
+defaults to precomputed features per BASELINE.json; this package is the
+sanctioned stretch that brings the upload→answer flow alive for images with
+no precomputed ``.npy``.
+"""
+
+from vilbert_multitask_tpu.detect.extractor import (  # noqa: F401
+    FallbackFeatureStore,
+    LiveFeatureExtractor,
+)
+from vilbert_multitask_tpu.detect.model import FasterRCNN  # noqa: F401

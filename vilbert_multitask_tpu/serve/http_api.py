@@ -160,6 +160,8 @@ class ApiServer:
                         "socket_id": str(uuid.uuid4()),
                         "tasks": api.store.list_tasks(),
                         "max_upload_images": api.serving.max_upload_images,
+                        "live_extract": bool(
+                            api.boot_info.get("live_extract")),
                     })
                 elif path.startswith("/get_task_details/"):
                     try:

@@ -122,24 +122,21 @@ class FallbackFeatureStore:
         """Map a job's image key to a file STRICTLY under media_root.
 
         The key is client-supplied (it rides in the job payload), so the
-        resolved path must stay confined — same realpath-containment rule
-        as the HTTP media handler (serve/http_api.py:_serve_media). An
-        absolute path is accepted only if it already points inside
-        media_root (that is exactly what /upload_image returns).
+        resolved path must stay confined — the same ``contained_path`` rule
+        the HTTP media handler uses (utils.py). An absolute path is
+        accepted only if it already points inside media_root (that is
+        exactly what /upload_image returns).
         """
         import os
 
-        root = os.path.realpath(self.media_root)
+        from vilbert_multitask_tpu.utils import contained_path
+
         candidates = [key, os.path.join(self.media_root, key),
                       os.path.join(self.media_root, "demo",
                                    os.path.basename(key))]
         for c in candidates:
-            full = os.path.realpath(c)
-            try:
-                contained = os.path.commonpath([root, full]) == root
-            except ValueError:  # different drives (windows) etc.
-                continue
-            if contained and os.path.isfile(full):
+            full = contained_path(self.media_root, c)
+            if full is not None and os.path.isfile(full):
                 return full
         return None
 

@@ -45,6 +45,7 @@ class ServeApp:
                 self.cfg, engine=dataclasses.replace(
                     self.cfg.engine, compilation_cache_dir=cache_dir))
         self.boot_info: dict = {}
+        self.extractor = None  # set when live_extract builds a detector
         self.hub = PushHub()
         self.queue = DurableQueue(
             s.queue_db_path, queue_name=s.queue_name,
@@ -75,6 +76,9 @@ class ServeApp:
                 # live detector (reference worker.py:59-223 capability;
                 # detect/extractor.py). Random weights unless a converted
                 # detector checkpoint is given.
+                import dataclasses as _dc
+
+                from vilbert_multitask_tpu.config import DetectorConfig
                 from vilbert_multitask_tpu.detect import (
                     FallbackFeatureStore,
                     LiveFeatureExtractor,
@@ -87,8 +91,14 @@ class ServeApp:
                     )
 
                     det_params = restore_params(detector_checkpoint)
-                extractor = LiveFeatureExtractor(params=det_params)
-                store = FallbackFeatureStore(store, extractor,
+                # The detector's fc6 width IS the trunk's region-feature
+                # width — derive it, never assume the 2048 default.
+                det_cfg = _dc.replace(
+                    DetectorConfig(),
+                    representation_size=self.cfg.model.v_feature_size)
+                self.extractor = LiveFeatureExtractor(det_cfg,
+                                                      params=det_params)
+                store = FallbackFeatureStore(store, self.extractor,
                                              media_root=s.media_root)
                 self.boot_info["live_extract"] = True
             t0 = time.perf_counter()
@@ -108,9 +118,15 @@ class ServeApp:
         self._worker_thread: Optional[threading.Thread] = None
 
     def warm(self) -> None:
-        """Pre-compile every shape bucket; timings land in ``/healthz``."""
+        """Pre-compile every shape bucket (and the live detector, if
+        enabled); timings land in ``/healthz``. Compile-at-request is
+        debug-only everywhere in this binary — a first upload must never
+        pay the detector JIT inside the worker thread."""
         t0 = time.perf_counter()
         self.engine.warmup()
+        if self.extractor is not None:
+            self.extractor.warmup()
+            self.boot_info["detector_warm"] = True
         self.boot_info.update(
             warmup_s=round(time.perf_counter() - t0, 1),
             buckets=list(self.cfg.engine.image_buckets),

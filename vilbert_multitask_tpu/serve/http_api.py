@@ -272,11 +272,14 @@ class ApiServer:
                 })
 
             def _serve_media(self):
+                from vilbert_multitask_tpu.utils import contained_path
+
                 rel = self.path[len("/media/"):].lstrip("/")
-                root = os.path.realpath(api.serving.media_root)
-                full = os.path.realpath(os.path.join(root, rel))
                 # containment check: resolved target must stay under media_root
-                if os.path.commonpath([root, full]) != root:
+                full = contained_path(
+                    api.serving.media_root,
+                    os.path.join(api.serving.media_root, rel))
+                if full is None:
                     self._json(403, {"error": "forbidden"})
                     return
                 if not os.path.isfile(full):

@@ -1,0 +1,25 @@
+"""Small shared utilities with security-relevant, must-not-diverge logic."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def contained_path(root: str, candidate: str) -> Optional[str]:
+    """Resolve ``candidate`` and return its realpath iff it stays under
+    ``root`` — else None.
+
+    The single containment rule for client-influenced filesystem access:
+    the HTTP media handler (serve/http_api.py) and the live-extraction
+    fallback store (detect/extractor.py) both route through here, so a
+    future hardening (symlink policy, drive handling) lands in one place.
+    """
+    real_root = os.path.realpath(root)
+    full = os.path.realpath(candidate)
+    try:
+        if os.path.commonpath([real_root, full]) != real_root:
+            return None
+    except ValueError:  # different drives / mixed abs-rel (windows)
+        return None
+    return full

@@ -258,3 +258,76 @@ def test_partition_rules_shard_big_matmuls(tiny_config):
     assert tuple(ffn_out) == ("tp", None)
     norm = specs["bert"]["encoder"]["t_layer_0"]["ffn"]["norm"]["scale"]
     assert tuple(norm) == ()
+
+
+def test_device_input_cache_hit_and_parity(engine):
+    """cache_keys pins the region tensors on device after the first run; a
+    repeat request reuses the SAME placed buffers (no re-upload) and decodes
+    identically to an uncached run."""
+    regions = make_regions(1, feat_dim=engine.cfg.model.v_feature_size, seed=3)
+    cached = engine.prepare(1, "what is on the table", regions,
+                            cache_keys=["imgA"])
+    plain = engine.prepare(1, "what is on the table", regions)
+    assert cached.cache_key == (("imgA",), 1) and plain.cache_key is None
+
+    _, r1 = engine.run(cached)
+    placed_first = engine._image_tensors(cached)
+    assert engine._image_tensors(cached) is placed_first  # LRU hit, same dict
+    import jax
+
+    assert all(isinstance(v, jax.Array) for v in placed_first.values())
+    _, r2 = engine.run(cached)
+    _, r_plain = engine.run(plain)
+    a1 = [a["confidence"] for a in r1.answers]
+    assert a1 == [a["confidence"] for a in r2.answers]
+    assert a1 == pytest.approx(
+        [a["confidence"] for a in r_plain.answers], abs=1e-6)
+
+
+def test_device_input_cache_lru_eviction(tiny_config):
+    cfg = FrameworkConfig(
+        model=tiny_config,
+        engine=_cpu_engine_cfg(max_regions=11, device_input_cache_entries=1),
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    regions = make_regions(1, feat_dim=tiny_config.v_feature_size)
+    for key in ("a", "b"):
+        eng.run(eng.prepare(1, "q", regions, cache_keys=[key]))
+    assert list(eng._input_cache) == [(("b",), 1)]  # "a" evicted
+
+    # entries=0 disables the cache entirely (no key ever recorded)
+    cfg0 = FrameworkConfig(
+        model=tiny_config,
+        engine=_cpu_engine_cfg(max_regions=11, device_input_cache_entries=0),
+    )
+    eng0 = InferenceEngine(cfg0, seed=0)
+    req = eng0.prepare(1, "q", regions, cache_keys=["a"])
+    assert req.cache_key is None
+
+
+def test_transfer_dtype_follows_compute_dtype(tiny_config):
+    """bf16 engines ship features as bf16 (half the host→device payload;
+    bit-identical because the model casts at its first dense layer); f32
+    engines — every golden-fixture test — keep f32 features untouched."""
+    import jax.numpy as jnp
+
+    f32 = InferenceEngine(FrameworkConfig(
+        model=tiny_config, engine=_cpu_engine_cfg(max_regions=11)), seed=0)
+    regions = make_regions(1, feat_dim=tiny_config.v_feature_size)
+    assert f32.prepare(1, "q", regions).features.dtype == np.float32
+
+    bf = InferenceEngine(FrameworkConfig(
+        model=tiny_config,
+        engine=dataclasses.replace(
+            _cpu_engine_cfg(max_regions=11), compute_dtype="bfloat16"),
+    ), seed=0)
+    req = bf.prepare(1, "q", regions)
+    assert req.features.dtype == jnp.bfloat16
+    # warmup and live requests must hit the SAME compiled program: the
+    # dummy batch ships the transfer dtype too (a dtype mismatch means a
+    # silent recompile on the first live request of every bucket).
+    for eng in (f32, bf):
+        assert (eng._dummy_batch(1)["features"].dtype
+                == eng.prepare(1, "q", regions).features.dtype)
+    _, result = bf.run(req)  # bf16 inputs flow through the forward + decode
+    assert result.task_id == 1

@@ -485,3 +485,53 @@ def test_websocket_bridge_delivers(stack):
             assert frame == {"info": "hello"}
     finally:
         bridge.stop()
+
+
+def test_worker_grounding_survives_unrenderable_source(stack, tmp_path):
+    """A grounding job whose path is a feature file (store-resolvable but
+    not a decodable image) must still ack with the box answer — only the
+    drawn overlay is skipped (render is best-effort)."""
+    s, hub, q, store, worker = stack
+    src = str(tmp_path / "img_a.npy")  # store key 'img_a', but NOT an image
+    with open(src, "wb") as f:
+        f.write(b"\x93NUMPY not really")
+    q.publish(make_job_message([src], "the left thing", 11, "sockD"))
+    assert worker.step() == "acked"
+    row = store.recent()[0]
+    assert row["task_id"] == 11 and len(row["answer_text"]["boxes"]) == 3
+    assert row["answer_images"] == []
+    assert "result_images" not in row["answer_text"]
+
+
+def test_device_cache_misses_when_feature_file_changes(stack, features_dir):
+    """Replacing a feature file on disk must be a device-cache MISS: cache
+    keys are content identities (path+mtime+size, FeatureStore.identity),
+    never the raw client-supplied image key."""
+    import time as _time
+
+    import numpy as np
+
+    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.store import save_reference_npy
+
+    s, hub, q, store, worker = stack
+    eng = worker.engine
+    q.publish(make_job_message(["img_a.jpg"], "what is this", 1, "sockE"))
+    assert worker.step() == "acked"
+    keys_before = [k for k in eng._input_cache]
+    assert keys_before, "first request must populate the device cache"
+
+    # rewrite img_a's features (different content, bumped mtime)
+    rng = np.random.RandomState(9)
+    feat_dim = eng.cfg.model.v_feature_size
+    boxes = rng.uniform(10, 200, size=(5, 4)).astype(np.float32)
+    boxes[:, 2:] = boxes[:, :2] + 15
+    path = os.path.join(features_dir, "img_a.npy")
+    _time.sleep(0.01)  # ensure mtime_ns moves even on coarse clocks
+    save_reference_npy(
+        path, RegionFeatures(rng.randn(5, feat_dim).astype(np.float32),
+                             boxes, 640, 480), "img_a")
+    q.publish(make_job_message(["img_a.jpg"], "what is this", 1, "sockE"))
+    assert worker.step() == "acked"
+    new_keys = [k for k in eng._input_cache if k not in keys_before]
+    assert new_keys, "changed file content must mint a NEW cache key"

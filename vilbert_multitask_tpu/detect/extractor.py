@@ -140,7 +140,27 @@ class FallbackFeatureStore:
                 return full
         return None
 
+    def identity(self, key: str) -> str:
+        """Content-stable identity (see FeatureStore.identity): precomputed
+        feature file when one exists, else the resolved image file —
+        path + mtime + size, so a replaced upload never hits a stale
+        device/host cache entry."""
+        from vilbert_multitask_tpu.features.store import file_identity
+
+        ident = getattr(self.store, "identity", None)
+        if ident is not None:
+            try:
+                return ident(key)
+            except (KeyError, FileNotFoundError):
+                pass
+        path = self._resolve_image(key)
+        if path is None:
+            raise KeyError(f"no features or image file for {key!r}")
+        return file_identity(path)
+
     def get(self, key: str) -> RegionFeatures:
+        from vilbert_multitask_tpu.features.store import file_identity
+
         try:
             return self.store.get(key)
         except (KeyError, FileNotFoundError):
@@ -150,14 +170,15 @@ class FallbackFeatureStore:
             raise KeyError(
                 f"no precomputed features for {key!r} and no image file "
                 f"under media_root to extract from")
+        cache_key = file_identity(path)
         with self._lock:
-            if path in self._cache:  # canonical path: one entry per file
-                self._cache.move_to_end(path)
-                return self._cache[path]
+            if cache_key in self._cache:  # content identity: one per version
+                self._cache.move_to_end(cache_key)
+                return self._cache[cache_key]
         region = self.extractor.extract(path)
         with self._lock:
-            self._cache[path] = region
-            self._cache.move_to_end(path)
+            self._cache[cache_key] = region
+            self._cache.move_to_end(cache_key)
             while len(self._cache) > self.max_cached:
                 self._cache.popitem(last=False)
         return region

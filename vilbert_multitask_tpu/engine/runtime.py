@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from collections import OrderedDict
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -73,17 +74,27 @@ def _enable_compilation_cache(path: str) -> None:
 
 @dataclasses.dataclass
 class PreparedRequest:
-    """Host-side buffers for one request, already bucketed."""
+    """Host-side buffers for one request, already bucketed.
+
+    ``features`` is stored in the engine's *transfer dtype*: bf16 when the
+    engine computes in bf16 (the model's first dense layer casts inputs to
+    the compute dtype anyway — see models/embeddings.py ImageEmbeddings — so
+    pre-casting on the host is bit-identical and halves the dominant
+    host→device payload), f32 otherwise (test/golden-fixture engines).
+    """
 
     spec: TaskSpec
     n_images: int
     bucket: int
     text: EncodedText  # (bucket, Nt)
-    features: np.ndarray  # (bucket, Nv, D)
-    spatials: np.ndarray  # (bucket, Nv, 5)
+    features: np.ndarray  # (bucket, Nv, D) transfer dtype
+    spatials: np.ndarray  # (bucket, Nv, 5) f32 (decode reads these host-side)
     image_mask: np.ndarray  # (bucket, Nv)
     task_ids: np.ndarray  # (bucket, 1)
     images: List[dec.ImageMeta]
+    # Stable identity of the image tensors for the device input cache, or
+    # None (novel uploads / synthetic defaults): (tuple_of_image_keys, bucket).
+    cache_key: Optional[Tuple] = None
 
 
 class InferenceEngine:
@@ -151,6 +162,12 @@ class InferenceEngine:
         self.kernel_fallback = False
         self._model_gen = 0
         self._fallback_lock = threading.Lock()
+        # Device input cache: encoded region tensors for content-stable
+        # (store-backed) images, pinned in HBM after first use — the input
+        # analogue of the one-time param device_put above. LRU over
+        # EngineConfig.device_input_cache_entries.
+        self._input_cache: "OrderedDict[Tuple, dict]" = OrderedDict()
+        self._input_cache_lock = threading.Lock()
 
     # ------------------------------------------------------------------ init
     def _check_vocab_coherence(self) -> None:
@@ -186,8 +203,11 @@ class InferenceEngine:
         ecfg, mcfg = self.cfg.engine, self.cfg.model
         return dict(
             input_ids=jnp.zeros((batch, ecfg.max_text_len), jnp.int32),
+            # Same dtype prepare() ships (transfer_dtype): a different input
+            # dtype is a different XLA program — warmup must compile the one
+            # live requests hit.
             features=jnp.zeros((batch, ecfg.max_regions, mcfg.v_feature_size),
-                               jnp.float32),
+                               self.transfer_dtype),
             spatials=jnp.zeros((batch, ecfg.max_regions, 5), jnp.float32),
             segment_ids=jnp.zeros((batch, ecfg.max_text_len), jnp.int32),
             input_mask=jnp.ones((batch, ecfg.max_text_len), jnp.int32),
@@ -393,17 +413,47 @@ class InferenceEngine:
                 _warm_one(b)
 
     # -------------------------------------------------------------- prepare
+    def cache_keys_for(self, image_paths: Sequence[str]) -> Optional[List[str]]:
+        """Content-stable device-cache keys for store-backed image paths,
+        or None when the attached feature store offers no identity (e.g.
+        test doubles). The single place the identity→cache-key contract
+        lives — serving (_intake) and predict() both use it."""
+        if self.feature_store is None:
+            return None
+        ident = getattr(self.feature_store, "identity", None)
+        if ident is None:
+            return None
+        return [ident(p) for p in image_paths]
+
+    @property
+    def transfer_dtype(self) -> np.dtype:
+        """Dtype region features ship to the device in: the compute dtype
+        when it's a 16-bit float (bit-identical — the model casts inputs to
+        compute dtype at its first dense layer — and half the bytes over the
+        host↔TPU link), f32 otherwise."""
+        if (jnp.issubdtype(self.compute_dtype, jnp.floating)
+                and self.compute_dtype.itemsize == 2):
+            return self.compute_dtype
+        return np.dtype(np.float32)
+
     def prepare(
         self,
         task_id: int,
         question: str,
         regions: Sequence[RegionFeatures],
         image_paths: Optional[Sequence[str]] = None,
+        *,
+        cache_keys: Optional[Sequence[str]] = None,
     ) -> PreparedRequest:
         """Host-side preprocessing: validate, tokenize, encode, bucket.
 
         Mirrors ``custom_prediction`` (worker.py:388-458) + the repeat
         semantics in ``prediction`` (worker.py:256-284).
+
+        ``cache_keys`` (one stable identity string per image, e.g. the
+        store path) opts this request's region tensors into the device
+        input cache — pass them ONLY for content-stable images; never
+        derived from the synthetic ``image_paths`` defaults.
         """
         if task_id not in TASK_REGISTRY:
             raise ValueError(f"unknown task_id {task_id}")
@@ -430,7 +480,14 @@ class InferenceEngine:
         ]
         encoded = [encode_image(r, ecfg.max_regions) for r in regions]
         feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
+        feats = feats.astype(self.transfer_dtype, copy=False)
         task_ids = np.full((bucket, 1), task_id, np.int32)
+        cache_key = None
+        if cache_keys is not None and ecfg.device_input_cache_entries > 0:
+            if len(cache_keys) != n:
+                raise ValueError(
+                    f"got {len(cache_keys)} cache keys for {n} images")
+            cache_key = (tuple(cache_keys), bucket)
         paths = list(image_paths or [f"image_{i}" for i in range(n)])
         if len(paths) != n:
             raise ValueError(
@@ -441,7 +498,8 @@ class InferenceEngine:
             for p, r in zip(paths, regions)
         ]
         return PreparedRequest(spec, n, bucket, text, feats, spatials,
-                               image_mask, task_ids, images)
+                               image_mask, task_ids, images,
+                               cache_key=cache_key)
 
     # ---------------------------------------------------------------- decode
     def decode(self, req: PreparedRequest, bundle, row: int = 0
@@ -475,16 +533,49 @@ class InferenceEngine:
         raise ValueError(f"unknown decode family {spec.decode}")
 
     # ---------------------------------------------------------------- serve
+    def _image_tensors(self, req: PreparedRequest) -> dict:
+        """features/spatials/image_mask for one request, device-cached when
+        the request carries a stable identity (store-backed images).
+
+        The reference re-ships every request's tensors over PCIe where the
+        copy is effectively free (worker.py:452-455); over a tunneled or
+        network-attached TPU the upload IS the latency, so content-stable
+        inputs get the same one-time device placement as the params.
+        """
+        tensors = dict(features=req.features, spatials=req.spatials,
+                       image_mask=req.image_mask)
+        if req.cache_key is None:
+            return tensors  # uploaded by device_put/jit dispatch per call
+        with self._input_cache_lock:
+            hit = self._input_cache.get(req.cache_key)
+            if hit is not None:
+                self._input_cache.move_to_end(req.cache_key)
+                return hit
+        if self.mesh is not None:
+            placed = jax.device_put(
+                tensors, shd.batch_shardings(tensors, self.mesh))
+        else:
+            placed = jax.device_put(tensors)
+        with self._input_cache_lock:
+            self._input_cache[req.cache_key] = placed
+            while (len(self._input_cache)
+                   > self.cfg.engine.device_input_cache_entries):
+                self._input_cache.popitem(last=False)
+        return placed
+
     def run(self, req: PreparedRequest, *, collect_attention: bool = False):
         """Device forward for a prepared request → (output, decoded result)."""
-        batch = dict(
-            input_ids=req.text.input_ids, features=req.features,
-            spatials=req.spatials, segment_ids=req.text.segment_ids,
-            input_mask=req.text.input_mask, image_mask=req.image_mask,
-            task_ids=req.task_ids,
+        text = dict(
+            input_ids=req.text.input_ids, segment_ids=req.text.segment_ids,
+            input_mask=req.text.input_mask, task_ids=req.task_ids,
         )
+        imgs = self._image_tensors(req)
         if self.mesh is not None:
-            batch = jax.device_put(batch, shd.batch_shardings(batch, self.mesh))
+            text = jax.device_put(text, shd.batch_shardings(text, self.mesh))
+            if req.cache_key is None:
+                imgs = jax.device_put(imgs,
+                                      shd.batch_shardings(imgs, self.mesh))
+        batch = {**text, **imgs}
         t0 = time.perf_counter()
         out, bundle = self._call_forward(req.bucket, collect_attention, batch)
         # One blocking fetch of the few-KB decode bundle — forward_s includes
@@ -574,7 +665,9 @@ class InferenceEngine:
         regions = self.feature_store.get_batch(image_paths)
         self.stage_times["features_s"] = time.perf_counter() - t0
         t0 = time.perf_counter()
-        req = self.prepare(task_id, question, regions, image_paths)
+        # Content-stable store identities → device-cacheable region tensors.
+        req = self.prepare(task_id, question, regions, image_paths,
+                           cache_keys=self.cache_keys_for(image_paths))
         self.stage_times["prepare_s"] = time.perf_counter() - t0
         _, result = self.run(req, collect_attention=collect_attention)
         return result

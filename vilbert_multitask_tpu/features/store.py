@@ -88,6 +88,12 @@ def image_key(image_path: str) -> str:
     return os.path.basename(image_path).split(".")[0]
 
 
+def file_identity(path: str) -> str:
+    """Content-stable cache identity for a file: path + mtime + size."""
+    st = os.stat(path)
+    return f"{path}:{st.st_mtime_ns}:{st.st_size}"
+
+
 class FeatureStore:
     """Directory-backed feature store with an LRU cache.
 
@@ -125,12 +131,19 @@ class FeatureStore:
             f"no feature file for key '{key}' under {self.root} (.npy/.vlfr)"
         )
 
+    def identity(self, image_path: str) -> str:
+        """Content-stable identity for this image's features: resolved file
+        path + mtime + size. Cache layers (the host LRU here, the engine's
+        device input cache) key on this so a replaced/edited feature file
+        is a cache MISS, never silently served stale."""
+        return file_identity(self.path_for(image_key(image_path)))
+
     def get(self, image_path: str) -> RegionFeatures:
-        key = image_key(image_path)
+        path = self.path_for(image_key(image_path))
+        key = file_identity(path)
         if key in self._cache:
             self._cache.move_to_end(key)
             return self._cache[key]
-        path = self.path_for(key)
         if path.endswith(".npy"):
             region = load_reference_npy(path)
         elif self._native_ok:

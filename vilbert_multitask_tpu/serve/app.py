@@ -76,8 +76,6 @@ class ServeApp:
                 # live detector (reference worker.py:59-223 capability;
                 # detect/extractor.py). Random weights unless a converted
                 # detector checkpoint is given.
-                import dataclasses as _dc
-
                 from vilbert_multitask_tpu.config import DetectorConfig
                 from vilbert_multitask_tpu.detect import (
                     FallbackFeatureStore,
@@ -93,7 +91,7 @@ class ServeApp:
                     det_params = restore_params(detector_checkpoint)
                 # The detector's fc6 width IS the trunk's region-feature
                 # width — derive it, never assume the 2048 default.
-                det_cfg = _dc.replace(
+                det_cfg = dataclasses.replace(
                     DetectorConfig(),
                     representation_size=self.cfg.model.v_feature_size)
                 self.extractor = LiveFeatureExtractor(det_cfg,

@@ -128,7 +128,12 @@ class ServeWorker:
         qa_id = self.store.create_question(task_id, question, image_paths,
                                            socket_id, queue_job_id=job.id)
         regions = self.engine.feature_store.get_batch(image_paths)
-        prepared = self.engine.prepare(task_id, question, regions, image_paths)
+        # Content-stable identities (resolved file + mtime + size, see
+        # FeatureStore.identity): repeat queries about unchanged images skip
+        # the feature upload; an edited/replaced file is a cache miss.
+        prepared = self.engine.prepare(
+            task_id, question, regions, image_paths,
+            cache_keys=self.engine.cache_keys_for(image_paths))
         return qa_id, prepared, t0
 
     def process_job(self, job: Job) -> Dict[str, Any]:
@@ -230,7 +235,19 @@ class ServeWorker:
             if os.path.exists(src):
                 out_dir = os.path.join(self.serving.media_root,
                                        self.serving.refer_expr_dir)
-                answer_images = draw_grounding_boxes(src, result.boxes, out_dir)
+                # Best-effort: jobs may reference a feature file (.npy/.vlfr)
+                # rather than a decodable image — the box ANSWER is still
+                # valid, only the rendered overlay is skipped.
+                try:
+                    answer_images = draw_grounding_boxes(
+                        src, result.boxes, out_dir)
+                except Exception as e:  # noqa: BLE001 — PIL raises a zoo
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "grounding render skipped for %s: %s", src, e)
+                    answer_images = []
+            if answer_images:
                 payload["result_images"] = answer_images
                 # Web paths for the browser client (the reference hardcodes
                 # a production hostname instead, result.html:116-123 — a

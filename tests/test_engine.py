@@ -268,11 +268,11 @@ def test_device_input_cache_hit_and_parity(engine):
     cached = engine.prepare(1, "what is on the table", regions,
                             cache_keys=["imgA"])
     plain = engine.prepare(1, "what is on the table", regions)
-    assert cached.cache_key == (("imgA",), 1) and plain.cache_key is None
+    assert cached.cache_keys == ["imgA"] and plain.cache_keys is None
 
     _, r1 = engine.run(cached)
-    placed_first = engine._image_tensors(cached)
-    assert engine._image_tensors(cached) is placed_first  # LRU hit, same dict
+    placed_first = engine._row_tensors(cached, 0)
+    assert engine._row_tensors(cached, 0) is placed_first  # LRU hit, same dict
     import jax
 
     assert all(isinstance(v, jax.Array) for v in placed_first.values())
@@ -293,7 +293,7 @@ def test_device_input_cache_lru_eviction(tiny_config):
     regions = make_regions(1, feat_dim=tiny_config.v_feature_size)
     for key in ("a", "b"):
         eng.run(eng.prepare(1, "q", regions, cache_keys=[key]))
-    assert list(eng._input_cache) == [(("b",), 1)]  # "a" evicted
+    assert list(eng._input_cache) == ["b"]  # "a" evicted
 
     # entries=0 disables the cache entirely (no key ever recorded)
     cfg0 = FrameworkConfig(
@@ -302,7 +302,43 @@ def test_device_input_cache_lru_eviction(tiny_config):
     )
     eng0 = InferenceEngine(cfg0, seed=0)
     req = eng0.prepare(1, "q", regions, cache_keys=["a"])
-    assert req.cache_key is None
+    assert req.cache_keys is None
+
+
+def test_run_many_uses_device_cache_and_matches_solo(engine):
+    """The batched path rides the same row cache as solo serving, and its
+    per-row decodes match run() row-for-row."""
+    feat_dim = engine.cfg.model.v_feature_size
+    r_a = make_regions(1, feat_dim=feat_dim, seed=11)
+    r_b = make_regions(1, feat_dim=feat_dim, seed=12)
+    reqs = [engine.prepare(1, "what is this", r_a, cache_keys=["many_a"]),
+            engine.prepare(15, "is it red", r_b, cache_keys=["many_b"]),
+            engine.prepare(1, "what is this", r_a, cache_keys=["many_a"])]
+    results = engine.run_many(reqs)
+    assert {"many_a", "many_b"} <= set(engine._input_cache)
+    solo = [engine.run(r)[1] for r in reqs]
+    for batched, s in zip(results, solo):
+        assert ([a["confidence"] for a in batched.answers]
+                == pytest.approx([a["confidence"] for a in s.answers],
+                                 abs=1e-5))
+
+
+def test_retrieval_pads_with_shared_device_row(engine):
+    """Bucket padding reuses ONE device-resident pad row (no per-request
+    pad upload), and padded results still match unpadded ones."""
+    import jax
+
+    feat_dim = engine.cfg.model.v_feature_size
+    regions = make_regions(3, feat_dim=feat_dim, seed=13)
+    req = engine.prepare(7, "a dog on a beach", regions,
+                         cache_keys=["p0", "p1", "p2"])
+    assert req.bucket == 4 and req.n_images == 3
+    feat_rows, spat_rows, mask_rows = engine._image_rows(req)
+    pad = engine._pad_row()
+    assert feat_rows[3] is pad["features"]  # the shared device row, not host
+    assert isinstance(pad["features"], jax.Array)
+    _, res = engine.run(req)
+    assert len(res.ranking) == 3
 
 
 def test_transfer_dtype_follows_compute_dtype(tiny_config):

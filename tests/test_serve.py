@@ -425,7 +425,8 @@ def test_parallel_warmup_compiles_all_buckets(tiny_framework_cfg, engine):
     a recompile marathon.)"""
     engine.warmup(parallel=True)
     for b in tiny_framework_cfg.engine.image_buckets:
-        assert (b, False, engine._model_gen) in engine._compiled
+        # single-device serving runs the per-row program (engine._forward_rows)
+        assert ("rows", b, False, engine._model_gen) in engine._compiled
     assert not engine.kernel_fallback
 
 

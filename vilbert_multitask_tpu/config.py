@@ -263,9 +263,10 @@ class EngineConfig:
     # them in HBM after the first request instead of re-uploading ~0.4 MB/
     # image (bf16) per query over the host↔TPU link. 0 disables. Keys are
     # explicit (engine.prepare cache_keys) — never inferred from synthetic
-    # path defaults. Eviction is entry-count LRU, not bytes: worst case at
-    # the 10-image bucket is ~4.1 MB/entry bf16 → ~265 MB for 64 entries
-    # (~530 MB on f32 engines) against the v5e's 16 GB HBM.
+    # path defaults. Entries are single image ROWS (max_regions ×
+    # v_feature_size ≈ 0.41 MB bf16 / 0.83 MB f32 at serving size), shared
+    # across buckets; eviction is entry-count LRU, so 64 entries ≈ 26 MB
+    # bf16 (53 MB f32) against the v5e's 16 GB HBM.
     device_input_cache_entries: int = 64
 
     def bucket_for(self, n_images: int) -> int:

@@ -158,13 +158,17 @@ class FallbackFeatureStore:
             raise KeyError(f"no features or image file for {key!r}")
         return file_identity(path)
 
-    def get(self, key: str) -> RegionFeatures:
+    def fetch(self, key: str):
+        """(features, content identity); identity stat'd BEFORE the read/
+        extraction — see FeatureStore.fetch for why that ordering."""
         from vilbert_multitask_tpu.features.store import file_identity
 
-        try:
-            return self.store.get(key)
-        except (KeyError, FileNotFoundError):
-            pass
+        store_fetch = getattr(self.store, "fetch", None)
+        if store_fetch is not None:
+            try:
+                return store_fetch(key)
+            except (KeyError, FileNotFoundError):
+                pass
         path = self._resolve_image(key)
         if path is None:
             raise KeyError(
@@ -174,14 +178,17 @@ class FallbackFeatureStore:
         with self._lock:
             if cache_key in self._cache:  # content identity: one per version
                 self._cache.move_to_end(cache_key)
-                return self._cache[cache_key]
+                return self._cache[cache_key], cache_key
         region = self.extractor.extract(path)
         with self._lock:
             self._cache[cache_key] = region
             self._cache.move_to_end(cache_key)
             while len(self._cache) > self.max_cached:
                 self._cache.popitem(last=False)
-        return region
+        return region, cache_key
+
+    def get(self, key: str) -> RegionFeatures:
+        return self.fetch(key)[0]
 
     def get_batch(self, keys: Sequence[str]):
         return [self.get(k) for k in keys]

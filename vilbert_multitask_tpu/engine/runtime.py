@@ -16,6 +16,13 @@ model:
 - **bf16 compute / f32 params** on the MXU; softmaxes run in f32;
 - **mesh-ready**: pass a ``Mesh`` and params are placed via the partition
   rules in :mod:`..parallel.sharding`; without one, single-device jit;
+- **host↔device bytes are the latency** on a tunneled/network-attached
+  chip, so the single-device program takes each image row as its own jit
+  argument (stacked to the batch INSIDE the compiled program): rows for
+  content-stable store images pin in HBM after first use (LRU input cache),
+  bucket padding reuses one shared device pad row, and features ship in
+  bf16 when the engine computes in bf16 — repeat queries upload ~KB of
+  text instead of ~MB of features;
 - label maps load once at boot (fixes the per-request pickle reload,
   SURVEY.md §2.4).
 """
@@ -41,6 +48,7 @@ from vilbert_multitask_tpu.config import (
 from vilbert_multitask_tpu.engine import decode as dec
 from vilbert_multitask_tpu.engine.labels import LabelMapStore
 from vilbert_multitask_tpu.features.pipeline import (
+    GLOBAL_BOX,
     EncodedImage,
     RegionFeatures,
     batch_images,
@@ -92,9 +100,10 @@ class PreparedRequest:
     image_mask: np.ndarray  # (bucket, Nv)
     task_ids: np.ndarray  # (bucket, 1)
     images: List[dec.ImageMeta]
-    # Stable identity of the image tensors for the device input cache, or
-    # None (novel uploads / synthetic defaults): (tuple_of_image_keys, bucket).
-    cache_key: Optional[Tuple] = None
+    # Stable per-image identities for the device input cache (one string
+    # per REAL image row, length n_images), or None for novel uploads /
+    # synthetic defaults. Row-level so any bucket size shares entries.
+    cache_keys: Optional[List[str]] = None
 
 
 class InferenceEngine:
@@ -166,7 +175,7 @@ class InferenceEngine:
         # (store-backed) images, pinned in HBM after first use — the input
         # analogue of the one-time param device_put above. LRU over
         # EngineConfig.device_input_cache_entries.
-        self._input_cache: "OrderedDict[Tuple, dict]" = OrderedDict()
+        self._input_cache: "OrderedDict[str, dict]" = OrderedDict()
         self._input_cache_lock = threading.Lock()
 
     # ------------------------------------------------------------------ init
@@ -286,7 +295,9 @@ class InferenceEngine:
         }
 
     def _forward(self, bucket: int, collect_attention: bool):
-        key = (bucket, collect_attention, self._model_gen)
+        """Batched-input program (the mesh path: inputs are device_put with
+        batch shardings as one (bucket, ...) tree per call)."""
+        key = ("batched", bucket, collect_attention, self._model_gen)
         if key not in self._compiled:
             model = self.model
 
@@ -299,6 +310,34 @@ class InferenceEngine:
                     batch["image_mask"], None, batch["task_ids"],
                     deterministic=True, output_all_attention_masks=attn,
                     # serving decodes never read the masked-LM/region heads
+                    compute_pretraining_heads=False,
+                )
+                return out, InferenceEngine._decode_bundle(out)
+
+            self._compiled[key] = fwd
+        return self._compiled[key]
+
+    def _forward_rows(self, bucket: int, collect_attention: bool):
+        """Per-row-input program (the single-device serving path): each
+        image row (features/spatials/mask) is its own jit argument, stacked
+        to the (bucket, ...) batch INSIDE the compiled program. Rows that
+        are already device-resident (the input cache, the shared pad row)
+        upload nothing; host rows upload individually — same program either
+        way, no extra dispatch for the stack."""
+        key = ("rows", bucket, collect_attention, self._model_gen)
+        if key not in self._compiled:
+            model = self.model
+
+            @partial(jax.jit, static_argnames=("attn",))
+            def fwd(params, text, feat_rows, spat_rows, mask_rows,
+                    attn=collect_attention):
+                out = model.apply(
+                    {"params": params},
+                    text["input_ids"], jnp.stack(feat_rows),
+                    jnp.stack(spat_rows),
+                    text["segment_ids"], text["input_mask"],
+                    jnp.stack(mask_rows), None, text["task_ids"],
+                    deterministic=True, output_all_attention_masks=attn,
                     compute_pretraining_heads=False,
                 )
                 return out, InferenceEngine._decode_bundle(out)
@@ -348,7 +387,8 @@ class InferenceEngine:
         self._model_gen += 1
         self._compiled.clear()  # memory hygiene; staleness is keyed out
 
-    def _call_forward(self, bucket: int, collect_attention: bool, batch):
+    def _call_forward(self, bucket: int, collect_attention: bool, *args,
+                      rows: bool = False):
         """All device forwards funnel through here — it's the Pallas probe.
 
         The kernels are default-on; if Mosaic rejects them on this backend
@@ -358,9 +398,10 @@ class InferenceEngine:
         evals, bench, and un-warmed engines whose first compile happens on a
         live request). A second failure propagates: it isn't the kernel.
         """
+        builder = self._forward_rows if rows else self._forward
         gen_before = self._model_gen
         try:
-            return self._forward(bucket, collect_attention)(self.params, batch)
+            return builder(bucket, collect_attention)(self.params, *args)
         except Exception as e:  # noqa: BLE001 — compile-time rejection
             with self._fallback_lock:
                 # Parallel warmup: several buckets can hit the rejection at
@@ -374,7 +415,7 @@ class InferenceEngine:
                 # error; re-running the forward would double device work
                 # exactly when the device is struggling.
                 raise
-            return self._forward(bucket, collect_attention)(self.params, batch)
+            return builder(bucket, collect_attention)(self.params, *args)
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                parallel: Optional[bool] = None) -> None:
@@ -399,7 +440,17 @@ class InferenceEngine:
                 # sharding is a different XLA program (fresh compile).
                 batch = jax.device_put(batch,
                                        shd.batch_shardings(batch, self.mesh))
-            _, bundle = self._call_forward(b, False, batch)
+                _, bundle = self._call_forward(b, False, batch)
+            else:
+                # Warm the per-row program run()/run_many() actually use.
+                text = {k: batch[k] for k in
+                        ("input_ids", "segment_ids", "input_mask", "task_ids")}
+                _, bundle = self._call_forward(
+                    b, False, text,
+                    tuple(batch["features"][i] for i in range(b)),
+                    tuple(batch["spatials"][i] for i in range(b)),
+                    tuple(batch["image_mask"][i] for i in range(b)),
+                    rows=True)
             jax.block_until_ready(bundle["vil_logit"])
 
         if parallel and len(buckets) > 1:
@@ -413,17 +464,27 @@ class InferenceEngine:
                 _warm_one(b)
 
     # -------------------------------------------------------------- prepare
-    def cache_keys_for(self, image_paths: Sequence[str]) -> Optional[List[str]]:
-        """Content-stable device-cache keys for store-backed image paths,
-        or None when the attached feature store offers no identity (e.g.
-        test doubles). The single place the identity→cache-key contract
-        lives — serving (_intake) and predict() both use it."""
+    def prepare_from_store(self, task_id: int, question: str,
+                           image_paths: Sequence[str]) -> PreparedRequest:
+        """prepare() with regions AND device-cache identities from the
+        attached feature store in one read (store.fetch) — the identity is
+        captured at read time, so the cache can never bind a fresh key to
+        stale tensors. The single place the store→cache-key contract lives;
+        serving (_intake) and predict() both come through here. Stores
+        without fetch() (minimal test doubles) just skip device caching."""
         if self.feature_store is None:
-            return None
-        ident = getattr(self.feature_store, "identity", None)
-        if ident is None:
-            return None
-        return [ident(p) for p in image_paths]
+            raise RuntimeError("prepare_from_store() needs a FeatureStore; "
+                               "use prepare() with in-memory regions instead")
+        fetch = getattr(self.feature_store, "fetch", None)
+        if fetch is not None:
+            pairs = [fetch(p) for p in image_paths]
+            regions = [r for r, _ in pairs]
+            cache_keys: Optional[List[str]] = [k for _, k in pairs]
+        else:
+            regions = self.feature_store.get_batch(image_paths)
+            cache_keys = None
+        return self.prepare(task_id, question, regions, image_paths,
+                            cache_keys=cache_keys)
 
     @property
     def transfer_dtype(self) -> np.dtype:
@@ -482,12 +543,12 @@ class InferenceEngine:
         feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
         feats = feats.astype(self.transfer_dtype, copy=False)
         task_ids = np.full((bucket, 1), task_id, np.int32)
-        cache_key = None
-        if cache_keys is not None and ecfg.device_input_cache_entries > 0:
+        if cache_keys is not None:
             if len(cache_keys) != n:
                 raise ValueError(
                     f"got {len(cache_keys)} cache keys for {n} images")
-            cache_key = (tuple(cache_keys), bucket)
+            cache_keys = (list(cache_keys)
+                          if ecfg.device_input_cache_entries > 0 else None)
         paths = list(image_paths or [f"image_{i}" for i in range(n)])
         if len(paths) != n:
             raise ValueError(
@@ -499,7 +560,7 @@ class InferenceEngine:
         ]
         return PreparedRequest(spec, n, bucket, text, feats, spatials,
                                image_mask, task_ids, images,
-                               cache_key=cache_key)
+                               cache_keys=cache_keys)
 
     # ---------------------------------------------------------------- decode
     def decode(self, req: PreparedRequest, bundle, row: int = 0
@@ -533,35 +594,60 @@ class InferenceEngine:
         raise ValueError(f"unknown decode family {spec.decode}")
 
     # ---------------------------------------------------------------- serve
-    def _image_tensors(self, req: PreparedRequest) -> dict:
-        """features/spatials/image_mask for one request, device-cached when
-        the request carries a stable identity (store-backed images).
+    def _pad_row(self) -> dict:
+        """The shared device-resident padding row: all requests pad their
+        bucket with IDENTICAL rows (zero features, global box, mask[0]=1 —
+        features/pipeline.py batch_images), so one row lives in HBM per
+        engine and bucket padding uploads nothing, ever."""
+        if getattr(self, "_pad_row_cached", None) is None:
+            ecfg, mcfg = self.cfg.engine, self.cfg.model
+            spat = np.zeros((ecfg.max_regions, 5), np.float32)
+            spat[0] = GLOBAL_BOX
+            mask = np.zeros((ecfg.max_regions,), np.int32)
+            mask[0] = 1
+            self._pad_row_cached = jax.device_put(dict(
+                features=np.zeros((ecfg.max_regions, mcfg.v_feature_size),
+                                  self.transfer_dtype),
+                spatials=spat, image_mask=mask))
+        return self._pad_row_cached
+
+    def _row_tensors(self, req: PreparedRequest, i: int) -> dict:
+        """One image row (features/spatials/image_mask), device-cached when
+        the request carries a stable identity for it (store-backed images).
 
         The reference re-ships every request's tensors over PCIe where the
         copy is effectively free (worker.py:452-455); over a tunneled or
         network-attached TPU the upload IS the latency, so content-stable
-        inputs get the same one-time device placement as the params.
+        rows get the same one-time device placement as the params.
         """
-        tensors = dict(features=req.features, spatials=req.spatials,
-                       image_mask=req.image_mask)
-        if req.cache_key is None:
-            return tensors  # uploaded by device_put/jit dispatch per call
+        host = dict(features=req.features[i], spatials=req.spatials[i],
+                    image_mask=req.image_mask[i])
+        if req.cache_keys is None:
+            return host  # uploaded by jit dispatch per call
+        key = req.cache_keys[i]
         with self._input_cache_lock:
-            hit = self._input_cache.get(req.cache_key)
+            hit = self._input_cache.get(key)
             if hit is not None:
-                self._input_cache.move_to_end(req.cache_key)
+                self._input_cache.move_to_end(key)
                 return hit
-        if self.mesh is not None:
-            placed = jax.device_put(
-                tensors, shd.batch_shardings(tensors, self.mesh))
-        else:
-            placed = jax.device_put(tensors)
+        placed = jax.device_put(host)
         with self._input_cache_lock:
-            self._input_cache[req.cache_key] = placed
+            self._input_cache[key] = placed
             while (len(self._input_cache)
                    > self.cfg.engine.device_input_cache_entries):
                 self._input_cache.popitem(last=False)
         return placed
+
+    def _image_rows(self, req: PreparedRequest) -> Tuple[tuple, tuple, tuple]:
+        """Per-row image tensors for the rows program: real rows from the
+        cache (or host), pad rows from the shared device pad row."""
+        rows = [self._row_tensors(req, i) for i in range(req.n_images)]
+        if req.bucket > req.n_images:
+            pad = self._pad_row()
+            rows.extend([pad] * (req.bucket - req.n_images))
+        return (tuple(r["features"] for r in rows),
+                tuple(r["spatials"] for r in rows),
+                tuple(r["image_mask"] for r in rows))
 
     def run(self, req: PreparedRequest, *, collect_attention: bool = False):
         """Device forward for a prepared request → (output, decoded result)."""
@@ -569,15 +655,22 @@ class InferenceEngine:
             input_ids=req.text.input_ids, segment_ids=req.text.segment_ids,
             input_mask=req.text.input_mask, task_ids=req.task_ids,
         )
-        imgs = self._image_tensors(req)
-        if self.mesh is not None:
-            text = jax.device_put(text, shd.batch_shardings(text, self.mesh))
-            if req.cache_key is None:
-                imgs = jax.device_put(imgs,
-                                      shd.batch_shardings(imgs, self.mesh))
-        batch = {**text, **imgs}
         t0 = time.perf_counter()
-        out, bundle = self._call_forward(req.bucket, collect_attention, batch)
+        if self.mesh is not None:
+            # Mesh serving ships the batched tree with batch shardings (a
+            # local multi-chip host: PCIe upload is cheap; the row cache is
+            # a single-device optimization).
+            batch = {**text, "features": req.features,
+                     "spatials": req.spatials, "image_mask": req.image_mask}
+            batch = jax.device_put(batch,
+                                   shd.batch_shardings(batch, self.mesh))
+            out, bundle = self._call_forward(req.bucket, collect_attention,
+                                             batch)
+        else:
+            feat_rows, spat_rows, mask_rows = self._image_rows(req)
+            out, bundle = self._call_forward(
+                req.bucket, collect_attention, text,
+                feat_rows, spat_rows, mask_rows, rows=True)
         # One blocking fetch of the few-KB decode bundle — forward_s includes
         # the single device→host round trip; decode is then pure host math.
         bundle = jax.device_get(bundle)
@@ -624,23 +717,42 @@ class InferenceEngine:
             rows = list(rows) + [pad_row] * pad
             return np.stack(rows, axis=0)
 
-        batch = dict(
+        text = dict(
             input_ids=pack([r.text.input_ids[0] for r in reqs],
                            reqs[-1].text.input_ids[0]),
-            features=pack([r.features[0] for r in reqs], reqs[-1].features[0]),
-            spatials=pack([r.spatials[0] for r in reqs], reqs[-1].spatials[0]),
             segment_ids=pack([r.text.segment_ids[0] for r in reqs],
                              reqs[-1].text.segment_ids[0]),
             input_mask=pack([r.text.input_mask[0] for r in reqs],
                             reqs[-1].text.input_mask[0]),
-            image_mask=pack([r.image_mask[0] for r in reqs],
-                            reqs[-1].image_mask[0]),
             task_ids=pack([r.task_ids[0] for r in reqs], reqs[-1].task_ids[0]),
         )
-        if self.mesh is not None:
-            batch = jax.device_put(batch, shd.batch_shardings(batch, self.mesh))
         t0 = time.perf_counter()
-        _, bundle = self._call_forward(bucket, False, batch)
+        if self.mesh is not None:
+            batch = dict(
+                text,
+                features=pack([r.features[0] for r in reqs],
+                              reqs[-1].features[0]),
+                spatials=pack([r.spatials[0] for r in reqs],
+                              reqs[-1].spatials[0]),
+                image_mask=pack([r.image_mask[0] for r in reqs],
+                                reqs[-1].image_mask[0]),
+            )
+            batch = jax.device_put(batch,
+                                   shd.batch_shardings(batch, self.mesh))
+            _, bundle = self._call_forward(bucket, False, batch)
+        else:
+            # Per-row image tensors: store-backed rows ride the device cache
+            # here too — under queue backlog (the batched path) repeat images
+            # cost no upload, same as solo serving. Pad slots use the shared
+            # device pad row (zero upload; discarded at decode).
+            rows = [self._row_tensors(r, 0) for r in reqs]
+            if pad:
+                rows.extend([self._pad_row()] * pad)
+            _, bundle = self._call_forward(
+                bucket, False, text,
+                tuple(r["features"] for r in rows),
+                tuple(r["spatials"] for r in rows),
+                tuple(r["image_mask"] for r in rows), rows=True)
         bundle = jax.device_get(bundle)
         self.stage_times["forward_s"] = time.perf_counter() - t0
         return [self.decode(r, bundle, row=i) for i, r in enumerate(reqs)]
@@ -662,12 +774,8 @@ class InferenceEngine:
             raise RuntimeError("predict() needs a FeatureStore; use "
                                "prepare()+run() with in-memory regions instead")
         t0 = time.perf_counter()
-        regions = self.feature_store.get_batch(image_paths)
-        self.stage_times["features_s"] = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        # Content-stable store identities → device-cacheable region tensors.
-        req = self.prepare(task_id, question, regions, image_paths,
-                           cache_keys=self.cache_keys_for(image_paths))
+        # One store read yields regions + device-cache identities together.
+        req = self.prepare_from_store(task_id, question, image_paths)
         self.stage_times["prepare_s"] = time.perf_counter() - t0
         _, result = self.run(req, collect_attention=collect_attention)
         return result

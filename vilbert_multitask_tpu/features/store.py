@@ -138,12 +138,16 @@ class FeatureStore:
         is a cache MISS, never silently served stale."""
         return file_identity(self.path_for(image_key(image_path)))
 
-    def get(self, image_path: str) -> RegionFeatures:
+    def fetch(self, image_path: str) -> tuple[RegionFeatures, str]:
+        """(features, content identity) — the identity is captured BEFORE
+        the read, so a file replaced mid-request can at worst bind an OLD
+        key to NEW content (which the next request's fresh stat misses and
+        re-reads), never a new key to stale content."""
         path = self.path_for(image_key(image_path))
         key = file_identity(path)
         if key in self._cache:
             self._cache.move_to_end(key)
-            return self._cache[key]
+            return self._cache[key], key
         if path.endswith(".npy"):
             region = load_reference_npy(path)
         elif self._native_ok:
@@ -155,7 +159,10 @@ class FeatureStore:
         self._cache[key] = region
         if len(self._cache) > self.max_cached:
             self._cache.popitem(last=False)
-        return region
+        return region, key
+
+    def get(self, image_path: str) -> RegionFeatures:
+        return self.fetch(image_path)[0]
 
     def get_batch(self, image_paths: Iterable[str]) -> list[RegionFeatures]:
         return [self.get(p) for p in image_paths]

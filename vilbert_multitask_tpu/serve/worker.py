@@ -127,13 +127,12 @@ class ServeWorker:
         # job id so redelivered attempts reuse one row.
         qa_id = self.store.create_question(task_id, question, image_paths,
                                            socket_id, queue_job_id=job.id)
-        regions = self.engine.feature_store.get_batch(image_paths)
-        # Content-stable identities (resolved file + mtime + size, see
-        # FeatureStore.identity): repeat queries about unchanged images skip
-        # the feature upload; an edited/replaced file is a cache miss.
-        prepared = self.engine.prepare(
-            task_id, question, regions, image_paths,
-            cache_keys=self.engine.cache_keys_for(image_paths))
+        # One store read yields regions + content-stable device-cache
+        # identities (file + mtime + size, captured at read time): repeat
+        # queries about unchanged images skip the feature upload; an
+        # edited/replaced file is a cache miss.
+        prepared = self.engine.prepare_from_store(task_id, question,
+                                                  image_paths)
         return qa_id, prepared, t0
 
     def process_job(self, job: Job) -> Dict[str, Any]:

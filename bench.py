@@ -370,11 +370,16 @@ def _run_child(timeout_s: float, extra_env: dict) -> tuple:
         (ln for ln in out_lines if ln.startswith('{"metric"')), None)
     if json_line:
         return json_line, ""
+    # Prefer the actual error line over boilerplate (JAX appends a "frames
+    # removed" notice AFTER the RuntimeError — tail[-1] alone is useless).
+    err_line = next(
+        (ln for ln in reversed(tail)
+         if "Error" in ln or "error:" in ln.lower()),
+        tail[-1] if tail else "no stderr")
     if timed_out:
-        err = (f"exceeded {timeout_s:.0f}s; last: "
-               f"{tail[-1] if tail else 'no stderr'}")[:400]
+        err = f"exceeded {timeout_s:.0f}s; last: {err_line}"[:400]
     else:
-        err = f"rc={proc.returncode}: {(tail[-1] if tail else 'no stderr')[:400]}"
+        err = f"rc={proc.returncode}: {err_line[:380]}"
     return None, err
 
 

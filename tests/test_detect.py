@@ -179,3 +179,24 @@ def test_fallback_store_feeds_vilbert_forward(tiny_extractor, tmp_path,
         dc.replace(tiny_framework_cfg), feature_store=fb)
     result = engine.predict(1, "what is in this new image", [str(img)])
     assert result.answers and len(result.answers) == 3
+
+
+def test_fallback_consults_get_only_stores():
+    """A duck-typed precomputed store exposing only get() is still consulted
+    first (documented lookup order); its hit carries a None identity so the
+    engine simply skips device-caching that row."""
+    from vilbert_multitask_tpu.detect.extractor import FallbackFeatureStore
+
+    sentinel = object()
+
+    class GetOnlyStore:
+        def get(self, key):
+            if key == "hit":
+                return sentinel
+            raise KeyError(key)
+
+    fb = FallbackFeatureStore(GetOnlyStore(), extractor=None,
+                              media_root="/nonexistent")
+    region, ident = fb.fetch("hit")
+    assert region is sentinel and ident is None
+    assert fb.get("hit") is sentinel

@@ -1,0 +1,235 @@
+"""Training-loop tests: multi-task sampling, per-head steps, JSONL data,
+and bit-exact checkpoint/resume (SURVEY.md §5 checkpoint/resume — absent in
+the reference, whose trainer lives out-of-repo; reference worker.py:44-46)."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.config import EngineConfig, FrameworkConfig
+from vilbert_multitask_tpu.train.loop import (
+    JsonlTaskData,
+    LoopConfig,
+    MultiTaskSampler,
+    SyntheticTaskData,
+    Trainer,
+    iou_grounding_target,
+    latest_checkpoint,
+    vqa_soft_target,
+)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures", "golden")
+
+
+@pytest.fixture(scope="module")
+def train_cfg(tiny_config):
+    return FrameworkConfig(
+        model=tiny_config,
+        engine=EngineConfig(max_text_len=12, max_regions=9,
+                            compute_dtype="float32",
+                            use_pallas_coattention=False,
+                            use_pallas_self_attention=False))
+
+
+def _loop(steps, **kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("log_every", 2)
+    kw.setdefault("ckpt_every", 10_000)
+    kw.setdefault("warmup_steps", 1)
+    kw.setdefault("learning_rate", 1e-4)
+    return LoopConfig(total_steps=steps, **kw)
+
+
+def _sampler(cfg, heads=("vqa", "tri", "grounding", "binary")):
+    return MultiTaskSampler({h: SyntheticTaskData(h, cfg) for h in heads})
+
+
+def test_multitask_smoke_trains_all_heads(train_cfg):
+    logs = []
+    t = Trainer(train_cfg, _sampler(train_cfg), _loop(8),
+                log_fn=lambda s: logs.append(json.loads(s)))
+    final = t.train()
+    assert np.isfinite(final["loss/total"])
+    assert final["step"] == 8
+    # the sampler actually alternated: over 8 steps at these weights more
+    # than one head must appear (seeded, deterministic)
+    assert len({m["head"] for m in logs}) > 1
+    # per-head programs compiled lazily; every logged head has one (logs
+    # sample every log_every steps, so compiled heads are a superset)
+    assert {m["head"] for m in logs} <= set(t._steps)
+
+
+def test_loss_decreases_on_fixed_batch(train_cfg):
+    """Single head, SAME batch every step: the optimizer must make progress
+    (loss strictly lower after 12 steps) — the training loop's end-to-end
+    gradient plumbing check."""
+
+    class FixedData(SyntheticTaskData):
+        def batch(self, batch_size, *, step=0):
+            return super().batch(batch_size, step=0)  # pinned batch
+
+    sampler = MultiTaskSampler({"vqa": FixedData("vqa", train_cfg)})
+    logs = []
+    t = Trainer(train_cfg, sampler, _loop(12, log_every=1),
+                log_fn=lambda s: logs.append(json.loads(s)))
+    t.train()
+    assert logs[-1]["loss/total"] < logs[0]["loss/total"]
+
+
+def test_checkpoint_resume_is_bit_exact(train_cfg, tmp_path):
+    """4 straight steps == 2 steps + checkpoint + resume + 2 steps, leaf for
+    leaf. The sampler is stateless over the global step and TrainState.rng
+    rides the snapshot, so the resumed run replays the identical schedule."""
+    import jax
+
+    out = str(tmp_path / "ckpts")
+    # uninterrupted reference run
+    ref = Trainer(train_cfg, _sampler(train_cfg), _loop(4),
+                  log_fn=lambda s: None)
+    ref.train()
+
+    # interrupted run: stop at 2 (ckpt_every=2 snapshots there), new Trainer
+    a = Trainer(train_cfg, _sampler(train_cfg), _loop(2, ckpt_every=2),
+                out_dir=out, log_fn=lambda s: None)
+    a.train()
+    found = latest_checkpoint(out)
+    assert found is not None and found[1] == 2
+
+    b = Trainer(train_cfg, _sampler(train_cfg), _loop(4, ckpt_every=2),
+                out_dir=out, log_fn=lambda s: None)
+    assert int(jax.device_get(b.state.step)) == 2  # resumed, not restarted
+    b.train()
+
+    ref_leaves = jax.tree_util.tree_leaves(jax.device_get(ref.state.params))
+    b_leaves = jax.tree_util.tree_leaves(jax.device_get(b.state.params))
+    for x, y in zip(ref_leaves, b_leaves):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_checkpoint_retention(train_cfg, tmp_path):
+    out = str(tmp_path / "ckpts")
+    t = Trainer(train_cfg, _sampler(train_cfg),
+                _loop(8, ckpt_every=2, keep_ckpts=2),
+                out_dir=out, log_fn=lambda s: None)
+    t.train()
+    snaps = sorted(n for n in os.listdir(out) if n.startswith("step_"))
+    assert snaps == ["step_00000006", "step_00000008"]
+
+
+def test_jsonl_datasets_golden_fixtures(train_cfg):
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+    from vilbert_multitask_tpu import assets
+
+    store = FeatureStore(os.path.join(GOLDEN, "features"))
+    tok = FullTokenizer.from_vocab_file(assets.default_vocab_path())
+    m, e = train_cfg.model, train_cfg.engine
+
+    vqa = JsonlTaskData("vqa", os.path.join(GOLDEN, "vqa.jsonl"), store, tok,
+                        train_cfg, label_map=["4", "brown", "left"])
+    b = vqa.batch(3, step=1)
+    assert b["vqa_target"].shape == (3, m.num_labels)
+    assert b["features"].shape == (3, e.max_regions, m.v_feature_size)
+
+    grd = JsonlTaskData("grounding", os.path.join(GOLDEN, "grounding.jsonl"),
+                        store, tok, train_cfg)
+    g = grd.batch(2, step=0)
+    sums = g["grounding_target"].sum(axis=-1)
+    np.testing.assert_allclose(sums, 1.0, atol=1e-5)  # soft targets normalized
+    assert (g["grounding_target"][:, 0] == 0).all()  # global region never gt
+
+    nlvr = JsonlTaskData("binary", os.path.join(GOLDEN, "nlvr2.jsonl"),
+                         store, tok, train_cfg)
+    nb = nlvr.batch(4, step=0)
+    assert nb["input_ids"].shape[0] == 4  # 2 pairs → 4 rows
+    assert nb["binary_label"].shape == (2,)
+    # pair rows share their caption tokens
+    np.testing.assert_array_equal(nb["input_ids"][0], nb["input_ids"][1])
+
+
+def test_jsonl_end_to_end_training_step(train_cfg):
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+    from vilbert_multitask_tpu import assets
+
+    store = FeatureStore(os.path.join(GOLDEN, "features"))
+    tok = FullTokenizer.from_vocab_file(assets.default_vocab_path())
+    datasets = {
+        "vqa": JsonlTaskData("vqa", os.path.join(GOLDEN, "vqa.jsonl"), store,
+                             tok, train_cfg, label_map=["4", "brown"]),
+        "grounding": JsonlTaskData(
+            "grounding", os.path.join(GOLDEN, "grounding.jsonl"), store, tok,
+            train_cfg),
+    }
+    t = Trainer(train_cfg, MultiTaskSampler(datasets), _loop(4),
+                log_fn=lambda s: None)
+    final = t.train()
+    assert np.isfinite(final["loss/total"])
+
+
+def test_target_builders():
+    t = vqa_soft_target(["a", "a", "a", "b"], {"a": 0, "b": 1}, 4)
+    assert t[0] == pytest.approx(0.9) and t[1] == pytest.approx(0.3)
+
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 100, 100], [90, 90, 99, 99]],
+                     np.float32)
+    g = iou_grounding_target(boxes, [0, 0, 100, 100], 3, 9)
+    assert g.shape == (9,) and g[2] == pytest.approx(1.0)  # row0=global shift
+    assert g.sum() == pytest.approx(1.0)
+    # no region over IoU 0.5 → best region takes the full mass
+    g2 = iou_grounding_target(boxes[:1], [50, 50, 60, 60], 1, 9)
+    assert g2[1] == pytest.approx(1.0)
+
+
+def test_mesh_sharded_training_loop(train_cfg):
+    """2 steps over the virtual 8-device dp×tp mesh (SURVEY.md §4 strategy)."""
+    from vilbert_multitask_tpu.config import MeshConfig
+    from vilbert_multitask_tpu.parallel import build_mesh
+
+    cfg = dataclasses.replace(
+        train_cfg,
+        model=train_cfg.model.tiny(
+            hidden_size=64, num_attention_heads=4, intermediate_size=128,
+            v_hidden_size=64, v_num_attention_heads=4, v_intermediate_size=128,
+            bi_hidden_size=64, bi_num_attention_heads=4,
+            bi_intermediate_size=128, vocab_size=512, num_labels=16,
+            gqa_num_labels=16, v_target_size=12))
+    mesh = build_mesh(MeshConfig(tp=2))
+    t = Trainer(cfg, _sampler(cfg, heads=("vqa", "tri")),
+                _loop(2, batch_size=8, log_every=1), mesh=mesh,
+                log_fn=lambda s: None)
+    final = t.train()
+    assert np.isfinite(final["loss/total"])
+
+
+def test_jsonl_clips_overprovisioned_store(train_cfg, tmp_path):
+    """A store entry with more boxes than the region budget is clipped to
+    the top max_regions-1 (confidence order), not a crash — same contract
+    as engine.prepare."""
+    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.store import save_reference_npy, FeatureStore
+    from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+    from vilbert_multitask_tpu import assets
+
+    e = train_cfg.engine
+    n_boxes = e.max_regions + 5  # over budget
+    rng = np.random.RandomState(0)
+    boxes = rng.uniform(10, 200, (n_boxes, 4)).astype(np.float32)
+    boxes[:, 2:] = boxes[:, :2] + 20
+    save_reference_npy(
+        str(tmp_path / "big.npy"),
+        RegionFeatures(rng.randn(n_boxes, train_cfg.model.v_feature_size)
+                       .astype(np.float32), boxes, 640, 480), "big")
+    jl = tmp_path / "grounding.jsonl"
+    jl.write_text(json.dumps({"expression": "the thing", "image": "big",
+                              "gt_box": [0, 0, 100, 100]}) + "\n")
+    ds = JsonlTaskData("grounding", str(jl), FeatureStore(str(tmp_path)),
+                       FullTokenizer.from_vocab_file(
+                           assets.default_vocab_path()), train_cfg)
+    b = ds.batch(2, step=0)
+    assert b["features"].shape[1] == e.max_regions
+    np.testing.assert_allclose(b["grounding_target"].sum(axis=-1), 1.0,
+                               atol=1e-5)

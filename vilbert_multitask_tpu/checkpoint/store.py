@@ -48,6 +48,49 @@ def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:
     return params
 
 
+def save_train_state(path: str, state: Any) -> None:
+    """Save a full TrainState (step/params/opt_state/rng) with Orbax.
+
+    The resume half of SURVEY.md §5's checkpoint/resume gap: the reference
+    only ever loads inference weights (worker.py:530-532); training state
+    never survives a crash there because training lives out-of-repo.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    tree = {"step": state.step, "params": state.params,
+            "opt_state": state.opt_state, "rng": state.rng}
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, jax.tree_util.tree_map(np.asarray, tree))
+
+
+def restore_train_state(path: str, template: Any, *, mesh=None) -> Any:
+    """Restore a TrainState saved by :func:`save_train_state`.
+
+    ``template`` (a freshly built TrainState with the same model/optimizer)
+    supplies the pytree structure — Orbax stores raw trees, and optax states
+    are NamedTuple chains that must be rebuilt around the restored leaves.
+    With ``mesh``, params and the optimizer's param-shaped moments land
+    directly in their sharded placement.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    tree = {"step": template.step, "params": template.params,
+            "opt_state": template.opt_state, "rng": template.rng}
+    host = jax.tree_util.tree_map(np.asarray, tree)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=host)
+    state = type(template)(
+        step=restored["step"], params=restored["params"],
+        opt_state=restored["opt_state"], rng=restored["rng"])
+    if mesh is not None:
+        from vilbert_multitask_tpu.train.step import shard_train_state
+
+        return shard_train_state(state, mesh)
+    return jax.device_put(state)
+
+
 def convert_and_save(torch_path: str, out_path: str, cfg=None) -> Any:
     """One-shot offline conversion: pytorch_model_*.bin → Orbax directory.
 

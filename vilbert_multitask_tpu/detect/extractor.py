@@ -160,13 +160,21 @@ class FallbackFeatureStore:
 
     def fetch(self, key: str):
         """(features, content identity); identity stat'd BEFORE the read/
-        extraction — see FeatureStore.fetch for why that ordering."""
+        extraction — see FeatureStore.fetch for why that ordering. The
+        precomputed store is ALWAYS consulted first (the documented lookup
+        order): a duck-typed store with only get() still wins — its hit just
+        carries a None identity (host upload, no device caching)."""
         from vilbert_multitask_tpu.features.store import file_identity
 
         store_fetch = getattr(self.store, "fetch", None)
         if store_fetch is not None:
             try:
                 return store_fetch(key)
+            except (KeyError, FileNotFoundError):
+                pass
+        else:
+            try:
+                return self.store.get(key), None
             except (KeyError, FileNotFoundError):
                 pass
         path = self._resolve_image(key)

@@ -622,8 +622,8 @@ class InferenceEngine:
         """
         host = dict(features=req.features[i], spatials=req.spatials[i],
                     image_mask=req.image_mask[i])
-        if req.cache_keys is None:
-            return host  # uploaded by jit dispatch per call
+        if req.cache_keys is None or req.cache_keys[i] is None:
+            return host  # no stable identity → uploaded per call
         key = req.cache_keys[i]
         with self._input_cache_lock:
             hit = self._input_cache.get(key)

@@ -207,8 +207,12 @@ def _measure_throughput(engine, cfg, *, n: int = 120):
                     (11, "the woman in the red coat"),
                     (16, "q: is it a person? a: no"),
                     (13, "two dogs play in the snow")]
+    # Same store-backed steady state as the latency pass: one pinned image,
+    # so the throughput number measures compute + text upload, not feature
+    # re-shipping (run_many rides the same device row cache as run()).
     reqs = [
-        engine.prepare(*single_tasks[i % len(single_tasks)], regions)
+        engine.prepare(*single_tasks[i % len(single_tasks)], regions,
+                       cache_keys=["bench_thr_img"])
         for i in range(n)
     ]
     engine.run_many(reqs[: max(cfg.engine.image_buckets)])  # warm path
@@ -426,9 +430,13 @@ def main() -> None:
     initialize backend 'axon'` killing the whole bench. Backend-init state
     is process-global in JAX, so each attempt gets a fresh interpreter.
     """
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
     timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1800"))
-    backoff_s = 30.0
+    # Linear 90s*i backoff: with 4 fast-failing init attempts (~2-3 min
+    # each) the loop rides out ~20 min of tunnel outage; a longer outage
+    # needs BENCH_ATTEMPTS raised — full tens-of-minutes coverage is not
+    # guaranteed by the defaults.
+    backoff_s = 90.0
     last_err = "no attempts ran"
     for i in range(1, attempts + 1):
         print(f"# bench attempt {i}/{attempts}", file=sys.stderr)

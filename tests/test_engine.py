@@ -367,3 +367,15 @@ def test_transfer_dtype_follows_compute_dtype(tiny_config):
                 == eng.prepare(1, "q", regions).features.dtype)
     _, result = bf.run(req)  # bf16 inputs flow through the forward + decode
     assert result.task_id == 1
+
+
+def test_input_cache_stats_counts(tiny_config):
+    eng = InferenceEngine(FrameworkConfig(
+        model=tiny_config, engine=_cpu_engine_cfg(max_regions=11)), seed=0)
+    regions = make_regions(1, feat_dim=tiny_config.v_feature_size)
+    assert eng.input_cache_stats == {"entries": 0, "hits": 0, "misses": 0}
+    req = eng.prepare(1, "q", regions, cache_keys=["statA"])
+    eng.run(req)
+    eng.run(req)
+    s = eng.input_cache_stats
+    assert s["entries"] == 1 and s["misses"] == 1 and s["hits"] >= 1

@@ -107,9 +107,10 @@ class ServeApp:
         self.engine = engine
         self.worker = ServeWorker(self.engine, self.queue, self.store,
                                   self.hub, s)
-        self.api = ApiServer(self.queue, self.store, self.hub, s,
-                             metrics=self.worker.metrics,
-                             boot_info=self.boot_info)
+        self.api = ApiServer(
+            self.queue, self.store, self.hub, s,
+            metrics=self.worker.metrics, boot_info=self.boot_info,
+            stats_fn=lambda: {"input_cache": self.engine.input_cache_stats})
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()

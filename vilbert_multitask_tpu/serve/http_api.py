@@ -45,6 +45,7 @@ class ApiServer:
         serving: Optional[ServingConfig] = None,
         metrics=None,
         boot_info: Optional[Dict[str, Any]] = None,
+        stats_fn=None,
     ):
         self.queue = queue
         self.store = store
@@ -54,6 +55,9 @@ class ApiServer:
         # Live reference filled in by ServeApp as boot stages finish
         # (engine init / warmup timings, kernel path) — surfaced in /healthz.
         self.boot_info = boot_info if boot_info is not None else {}
+        # Optional live-stats callable merged into /metrics (ServeApp wires
+        # the engine's device input-cache counters through this).
+        self.stats_fn = stats_fn
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -204,6 +208,11 @@ class ApiServer:
                     snap = (api.metrics.snapshot()
                             if api.metrics is not None else {})
                     snap["queue"] = api.queue.counts()
+                    if api.stats_fn is not None:
+                        try:
+                            snap.update(api.stats_fn())
+                        except Exception:  # noqa: BLE001 — stats best-effort
+                            pass
                     self._json(200, snap)
                 else:
                     self._json(404, {"error": "not found"})

@@ -233,3 +233,75 @@ def test_jsonl_clips_overprovisioned_store(train_cfg, tmp_path):
     assert b["features"].shape[1] == e.max_regions
     np.testing.assert_allclose(b["grounding_target"].sum(axis=-1), 1.0,
                                atol=1e-5)
+
+
+def test_eval_hook_scores_on_serving_path(train_cfg, tmp_path):
+    """eval_every runs the eval HARNESS on the trainer's current params via
+    a real InferenceEngine — scores land in the training log with eval/
+    prefixes and stay in range."""
+    from vilbert_multitask_tpu.evals.harness import load_jsonl
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.train.loop import EvalHook
+
+    hook = EvalHook(
+        train_cfg, FeatureStore(os.path.join(GOLDEN, "features")),
+        {"vqa": load_jsonl(os.path.join(GOLDEN, "vqa.jsonl")),
+         "nlvr2": load_jsonl(os.path.join(GOLDEN, "nlvr2.jsonl"))})
+    logs = []
+    t = Trainer(train_cfg, _sampler(train_cfg),
+                _loop(4, eval_every=2, log_every=1), eval_fn=hook,
+                log_fn=lambda s: logs.append(json.loads(s)))
+    t.train()
+    evals = [m for m in logs if any(k.startswith("eval/") for k in m)]
+    assert len(evals) == 2  # steps 2 and 4
+    for m in evals:
+        assert 0.0 <= m["eval/vqa/accuracy"] <= 1.0
+        assert 0.0 <= m["eval/nlvr2/accuracy"] <= 1.0
+    # engine built once, params swapped per eval (no rebuild per call)
+    assert hook._engine is not None
+
+
+def test_eval_hook_rejects_unknown_tasks_and_skips_metadata(train_cfg):
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.train.loop import EvalHook
+
+    store = FeatureStore(os.path.join(GOLDEN, "features"))
+    with pytest.raises(ValueError, match="unknown eval tasks"):
+        EvalHook(train_cfg, store, {"snli_ve": []})
+
+    from vilbert_multitask_tpu.evals.harness import load_jsonl
+    hook = EvalHook(train_cfg, store,
+                    {"vqa": load_jsonl(os.path.join(GOLDEN, "vqa.jsonl"))})
+    t = Trainer(train_cfg, _sampler(train_cfg), _loop(1), log_fn=lambda s: None)
+    scores = hook(1, t.state)
+    assert any(k == "eval/vqa/accuracy" for k in scores)
+    # metadata (n / task_id / wall_s) never masquerades as a score
+    assert not any(k.endswith(("/n", "/task_id", "/wall_s")) for k in scores)
+
+
+def test_eval_hook_on_mesh_sharded_params(train_cfg):
+    """--eval-every on a multi-chip run: the hook's engine must accept the
+    trainer's tp/dp-sharded params (mesh forwarded), not crash on
+    incompatible device placements."""
+    from vilbert_multitask_tpu.config import MeshConfig
+    from vilbert_multitask_tpu.evals.harness import load_jsonl
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.parallel import build_mesh
+    from vilbert_multitask_tpu.train.loop import EvalHook
+
+    cfg = dataclasses.replace(
+        train_cfg,
+        model=train_cfg.model.tiny(
+            hidden_size=64, num_attention_heads=4, intermediate_size=128,
+            v_hidden_size=64, v_num_attention_heads=4, v_intermediate_size=128,
+            bi_hidden_size=64, bi_num_attention_heads=4,
+            bi_intermediate_size=128, vocab_size=2048, num_labels=16,
+            gqa_num_labels=16, v_target_size=12))
+    mesh = build_mesh(MeshConfig(tp=2))
+    hook = EvalHook(cfg, FeatureStore(os.path.join(GOLDEN, "features")),
+                    {"nlvr2": load_jsonl(os.path.join(GOLDEN, "nlvr2.jsonl"))},
+                    mesh=mesh)
+    t = Trainer(cfg, _sampler(cfg, heads=("tri",)),
+                _loop(1, batch_size=8), mesh=mesh, log_fn=lambda s: None)
+    scores = hook(1, t.state)
+    assert 0.0 <= scores["eval/nlvr2/accuracy"] <= 1.0

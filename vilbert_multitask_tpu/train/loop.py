@@ -341,6 +341,64 @@ class LoopConfig:
     keep_ckpts: int = 3
     seed: int = 0
     retrieval_group_size: int = 2
+    # 0 disables; otherwise Trainer calls its eval_fn(step, state) at this
+    # cadence (and at the final step) and logs the returned scores.
+    eval_every: int = 0
+
+
+class EvalHook:
+    """In-training evaluation on the SERVING path: the trainer's current
+    params drop into an InferenceEngine and run the eval harness — scores
+    measure exactly what a deployed worker would answer, decode included
+    (evals/harness.py), not a proxy metric.
+
+    The engine is built lazily once (its jitted programs compile on first
+    eval and are reused; only the param reference swaps per eval).
+    """
+
+    # Result fields that are metadata, not scores — kept out of the eval/
+    # log keys so "max over eval/*" checkpoint selection can't pick up n.
+    _META_KEYS = frozenset({"task_id", "n", "wall_s", "metric"})
+
+    def __init__(self, cfg: FrameworkConfig, feature_store, tasks:
+                 Dict[str, Sequence[Dict]], *, batch: int = 8,
+                 label_store=None, tokenizer=None, mesh=None):
+        from vilbert_multitask_tpu.evals.harness import Evaluator
+
+        unknown = set(tasks) - set(Evaluator.EVAL_FNS)
+        if unknown:
+            raise ValueError(
+                f"unknown eval tasks {sorted(unknown)}; the harness serves "
+                f"{sorted(Evaluator.EVAL_FNS)}")
+        self.cfg = cfg
+        self.store = feature_store
+        self.tasks = dict(tasks)  # eval task name → examples
+        self.batch = batch
+        self.label_store = label_store
+        self.tokenizer = tokenizer
+        self.mesh = mesh  # the TRAINER's mesh: sharded params need an
+        # engine that places inputs with matching shardings
+        self._engine = None
+
+    def __call__(self, step: int, state) -> Dict[str, float]:
+        from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+        from vilbert_multitask_tpu.evals.harness import Evaluator
+
+        if self._engine is None:
+            self._engine = InferenceEngine(
+                self.cfg, params=state.params, feature_store=self.store,
+                label_store=self.label_store, tokenizer=self.tokenizer,
+                mesh=self.mesh)
+        else:
+            self._engine.params = state.params  # same tree structure
+        ev = Evaluator(self._engine, batch=self.batch)
+        out: Dict[str, float] = {}
+        for task, examples in self.tasks.items():
+            scores = ev.run(task, examples)
+            for k, v in scores.items():
+                if k not in self._META_KEYS and isinstance(v, (int, float)):
+                    out[f"eval/{task}/{k}"] = round(float(v), 5)
+        return out
 
 
 class Trainer:
@@ -349,6 +407,8 @@ class Trainer:
     def __init__(self, cfg: FrameworkConfig, sampler: MultiTaskSampler,
                  loop: LoopConfig, *, out_dir: Optional[str] = None,
                  mesh=None, init_params=None,
+                 eval_fn: Optional[Callable[[int, TrainState],
+                                            Dict[str, float]]] = None,
                  log_fn: Callable[[str], None] = print):
         import jax
         import jax.numpy as jnp
@@ -357,6 +417,7 @@ class Trainer:
 
         self.cfg, self.sampler, self.loop = cfg, sampler, loop
         self.out_dir, self.mesh, self.log = out_dir, mesh, log_fn
+        self.eval_fn = eval_fn
         # Training computes in bf16 like serving; master params stay f32.
         self.model = ViLBertForVLTasks(
             dataclasses.replace(cfg.model,
@@ -468,6 +529,11 @@ class Trainer:
                     self.log(json.dumps(m))
                     last_metrics = m
                     t0, window = time.perf_counter(), now
+                if (self.eval_fn is not None and lp.eval_every
+                        and (now % lp.eval_every == 0
+                             or now == lp.total_steps)):
+                    scores = self.eval_fn(now, self.state)
+                    self.log(json.dumps({"step": now, **scores}))
                 if self.out_dir and (now % lp.ckpt_every == 0
                                      or now == lp.total_steps):
                     self._save(now)
@@ -498,6 +564,11 @@ def main(argv=None) -> None:
     p.add_argument("--lr", type=float, default=4e-5)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--ckpt-every", type=int, default=200)
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="run the eval harness on the current params every N "
+                        "steps (needs --data-root with eval_<task>.jsonl "
+                        "files; tasks: vqa/gqa/grounding/visual7w/"
+                        "retrieval/nlvr2)")
     args = p.parse_args(argv)
 
     cfg = FrameworkConfig()
@@ -540,10 +611,28 @@ def main(argv=None) -> None:
 
     loop = LoopConfig(total_steps=args.steps, batch_size=args.batch,
                       learning_rate=args.lr, log_every=args.log_every,
-                      ckpt_every=args.ckpt_every,
+                      ckpt_every=args.ckpt_every, eval_every=args.eval_every,
                       warmup_steps=max(1, args.steps // 10))
+    eval_fn = None
+    if args.eval_every and args.data_root:
+        from vilbert_multitask_tpu.evals.harness import Evaluator, load_jsonl
+
+        eval_tasks = {}
+        for name in sorted(Evaluator.EVAL_FNS):  # the harness's task names
+            path = os.path.join(args.data_root, f"eval_{name}.jsonl")
+            if os.path.exists(path):
+                eval_tasks[name] = load_jsonl(path)
+        if eval_tasks:
+            # Share the training run's tokenizer/labels/mesh so the eval
+            # engine measures exactly this configuration.
+            eval_fn = EvalHook(cfg, store, eval_tasks, label_store=labels,
+                               tokenizer=tok, mesh=mesh)
+            print(f"# eval tasks: {sorted(eval_tasks)}")
+        else:
+            print("# --eval-every set but no eval_<task>.jsonl under "
+                  "--data-root; skipping evals")
     trainer = Trainer(cfg, MultiTaskSampler(datasets), loop,
-                      out_dir=args.out, mesh=mesh)
+                      out_dir=args.out, mesh=mesh, eval_fn=eval_fn)
     final = trainer.train()
     print(json.dumps({"final": final}))
 

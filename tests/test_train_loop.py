@@ -305,3 +305,124 @@ def test_eval_hook_on_mesh_sharded_params(train_cfg):
                 _loop(1, batch_size=8), mesh=mesh, log_fn=lambda s: None)
     scores = hook(1, t.state)
     assert 0.0 <= scores["eval/nlvr2/accuracy"] <= 1.0
+
+
+def test_mlm_masking_properties(train_cfg):
+    from vilbert_multitask_tpu.train.loop import apply_mlm_masking
+
+    rng = np.random.default_rng(0)
+    B, Nt = 64, 24
+    ids = rng.integers(5, 400, (B, Nt)).astype(np.int32)
+    ids[:, 0] = 101  # [CLS]-like special
+    mask = np.ones((B, Nt), np.int32)
+    mask[:, -4:] = 0  # padding
+    masked, labels = apply_mlm_masking(
+        ids.copy(), mask, np.random.default_rng(1), mask_id=103,
+        vocab_size=400, special_ids=(0, 101, 102, 103))
+    picked = labels >= 0
+    rate = picked.mean()
+    assert 0.10 < rate < 0.20  # ~15%
+    assert not picked[:, 0].any()  # specials never masked
+    assert not picked[:, -4:].any()  # padding never masked
+    np.testing.assert_array_equal(labels[picked], ids[picked])  # originals
+    assert (masked[picked] == 103).mean() > 0.6  # ~80% → [MASK]
+    assert (masked[~picked] == ids[~picked]).all()  # others untouched
+
+
+def test_mrm_masking_targets(train_cfg):
+    """Masking happens on RAW regions (pre-encoding): the global mean-pool
+    row must see zeros for masked regions, never their content."""
+    from vilbert_multitask_tpu.features.pipeline import (
+        RegionFeatures,
+        encode_image,
+    )
+    from vilbert_multitask_tpu.train.loop import apply_mrm_masking
+
+    Nr, D, C, MAX = 8, 16, 6, 9
+    rng = np.random.RandomState(0)
+    boxes = rng.uniform(10, 200, (Nr, 4)).astype(np.float32)
+    boxes[:, 2:] = boxes[:, :2] + 20
+    cp = rng.rand(Nr, C).astype(np.float32)
+    regions = [
+        RegionFeatures(np.ones((Nr, D), np.float32) * (i + 1), boxes,
+                       640, 480, cls_prob=[cp, None, cp[:, :3]][i])
+        for i in range(3)
+    ]
+    masked, target, mmask = apply_mrm_masking(
+        regions, np.random.default_rng(3), n_classes=C, max_regions=MAX)
+    assert not mmask[:, 0].any()  # global row never masked
+    np.testing.assert_allclose(target.sum(-1), 1.0, atol=1e-5)
+    # cls_prob rows carry the detector distribution; None / wrong width → uniform
+    np.testing.assert_allclose(target[0, 1], cp[0] / cp[0].sum(), atol=1e-6)
+    np.testing.assert_allclose(target[1, 1], np.full(C, 1 / C), atol=1e-6)
+    np.testing.assert_allclose(target[2, 1], np.full(C, 1 / C), atol=1e-6)
+    # leak check: encoding AFTER masking → the global mean is the mean of
+    # the MASKED features (zeros included), not the originals
+    for i, (r, m) in enumerate(zip(masked, mmask)):
+        enc = encode_image(r, MAX)
+        n_masked = int(m[1 : Nr + 1].sum())
+        assert n_masked > 0  # seeded: every image masks something
+        expected_mean = (i + 1) * (Nr - n_masked) / Nr
+        np.testing.assert_allclose(enc.features[0], expected_mean, atol=1e-5)
+        # masked encoded rows are zero
+        rows = np.where(m[1 : Nr + 1] > 0)[0] + 1
+        assert (enc.features[rows] == 0).all()
+
+
+def test_pretrain_head_trains(train_cfg):
+    """Joint MLM+MRM pretraining step (the BertForMultiModalPreTraining
+    capability, reference worker.py:45) — synthetic data, finite loss,
+    both objective losses present."""
+    logs = []
+    t = Trainer(train_cfg,
+                MultiTaskSampler({"pretrain":
+                                  SyntheticTaskData("pretrain", train_cfg)}),
+                _loop(3, log_every=1),
+                log_fn=lambda s: logs.append(json.loads(s)))
+    final = t.train()
+    assert np.isfinite(final["loss/total"])
+    assert "loss/mlm" in final and "loss/mrm" in final
+
+
+def test_pretrain_jsonl_captions(train_cfg, tmp_path):
+    """Caption-pair pretraining from the reference .npy schema with
+    cls_prob: the MRM target is the stored detector distribution."""
+    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.store import (
+        FeatureStore,
+        save_reference_npy,
+    )
+    from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+    from vilbert_multitask_tpu import assets
+
+    m, e = train_cfg.model, train_cfg.engine
+    rng = np.random.RandomState(0)
+    boxes = rng.uniform(10, 200, (5, 4)).astype(np.float32)
+    boxes[:, 2:] = boxes[:, :2] + 20
+    cp = rng.random((5, m.v_target_size)).astype(np.float32)
+    save_reference_npy(
+        str(tmp_path / "cap_a.npy"),
+        RegionFeatures(rng.randn(5, m.v_feature_size).astype(np.float32),
+                       boxes, 640, 480, cls_prob=cp), "cap_a")
+    jl = tmp_path / "pretrain.jsonl"
+    jl.write_text(json.dumps({"caption": "a dog runs on the beach",
+                              "image": "cap_a"}) + "\n")
+    ds = JsonlTaskData("pretrain", str(jl), FeatureStore(str(tmp_path)),
+                       FullTokenizer.from_vocab_file(
+                           assets.default_vocab_path()), train_cfg)
+    b = ds.batch(2, step=3)
+    assert b["task_ids"][0, 0] == 0  # reserved pretraining task token
+    assert b["mrm_target"].shape == (2, e.max_regions, m.v_target_size)
+    np.testing.assert_allclose(
+        b["mrm_target"][0, 1], cp[0] / cp[0].sum(), atol=1e-5)
+    # dynamic masking: different steps mask differently
+    b2 = ds.batch(2, step=4)
+    assert not np.array_equal(b["mlm_labels"], b2["mlm_labels"])
+    # round-trip through the store kept cls_prob (loader regression)
+    region = FeatureStore(str(tmp_path)).get("cap_a")
+    assert region.cls_prob is not None and region.cls_prob.shape == cp.shape
+
+    t = Trainer(train_cfg, MultiTaskSampler({"pretrain": ds}), _loop(2),
+                log_fn=lambda s: None)
+    final = t.train()
+    assert np.isfinite(final["loss/total"])

@@ -52,6 +52,7 @@ from vilbert_multitask_tpu.features.pipeline import (
     EncodedImage,
     RegionFeatures,
     batch_images,
+    clip_regions,
     encode_image,
 )
 from vilbert_multitask_tpu.features.store import FeatureStore
@@ -533,14 +534,7 @@ class InferenceEngine:
         # Feature files are confidence-ordered (extractor top-K order, same
         # as the reference's .npy dumps), so an over-provisioned store clips
         # to this engine's region budget instead of erroring.
-        regions = [
-            dataclasses.replace(
-                r, features=r.features[: ecfg.max_regions - 1],
-                boxes=r.boxes[: ecfg.max_regions - 1],
-                num_boxes=min(r.num_boxes, ecfg.max_regions - 1))
-            if r.num_boxes > ecfg.max_regions - 1 else r
-            for r in regions
-        ]
+        regions = clip_regions(regions, ecfg.max_regions)
         encoded = [encode_image(r, ecfg.max_regions) for r in regions]
         feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
         feats = feats.astype(self.transfer_dtype, copy=False)

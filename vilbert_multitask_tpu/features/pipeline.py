@@ -28,13 +28,19 @@ import numpy as np
 @dataclasses.dataclass
 class RegionFeatures:
     """One image's detector output (the `.npy` schema fields that matter,
-    reference worker.py:209-216)."""
+    reference worker.py:209-216).
+
+    ``cls_prob`` (the detector's per-region class distribution, also in the
+    reference schema) is optional — serving never reads it, but the
+    masked-region pretraining objective uses it as the soft target
+    (train/losses.py masked_region_loss)."""
 
     features: np.ndarray  # (num_boxes, feat_dim) fc6 features
     boxes: np.ndarray  # (num_boxes, 4) absolute xyxy pixel coords
     image_width: int
     image_height: int
     num_boxes: int | None = None  # defaults to features.shape[0]
+    cls_prob: np.ndarray | None = None  # (num_boxes, n_classes) detector dist
 
     def __post_init__(self):
         if self.num_boxes is None:
@@ -87,6 +93,24 @@ def encode_image(region: RegionFeatures, max_regions: int = 101) -> EncodedImage
     mask = np.zeros((max_regions,), np.int32)
     mask[: n + 1] = 1
     return EncodedImage(out_feats, out_spatials, mask)
+
+
+def clip_regions(regions: Sequence[RegionFeatures],
+                 max_regions: int) -> list[RegionFeatures]:
+    """Clip over-provisioned region sets to the budget (``max_regions`` - 1
+    detector rows + the global row). Stores are confidence-ordered, so the
+    clip keeps the top boxes. The ONE clip implementation — serving
+    (engine.prepare) and training (train/loop) both use it, so a new
+    per-region field only needs slicing here."""
+    budget = max_regions - 1
+    return [
+        dataclasses.replace(
+            r, features=r.features[:budget], boxes=r.boxes[:budget],
+            num_boxes=min(r.num_boxes, budget),
+            cls_prob=r.cls_prob[:budget] if r.cls_prob is not None else None)
+        if r.num_boxes > budget else r
+        for r in regions
+    ]
 
 
 def batch_images(

@@ -32,12 +32,14 @@ _VLFR_MAGIC = b"VLFR\x01"
 def load_reference_npy(path: str) -> RegionFeatures:
     """Read one image's features in the reference ``.npy`` dict schema."""
     raw = np.load(path, allow_pickle=True).item()
+    cls_prob = np.asarray(raw.get("cls_prob", ()), np.float32)
     return RegionFeatures(
         features=np.asarray(raw["features"], np.float32),
         boxes=np.asarray(raw["bbox"], np.float32),
         image_width=int(raw["image_width"]),
         image_height=int(raw["image_height"]),
         num_boxes=int(raw.get("num_boxes", len(raw["features"]))),
+        cls_prob=cls_prob if cls_prob.size else None,
     )
 
 
@@ -53,13 +55,27 @@ def save_reference_npy(path: str, region: RegionFeatures, image_id: str,
         "image_width": int(region.image_width),
         "image_height": int(region.image_height),
         "objects": objects if objects is not None else np.zeros((0,), np.int64),
-        "cls_prob": cls_prob if cls_prob is not None else np.zeros((0, 0), np.float32),
+        "cls_prob": (cls_prob if cls_prob is not None
+                     else region.cls_prob if region.cls_prob is not None
+                     else np.zeros((0, 0), np.float32)),
     }
     np.save(path, info)
 
 
 def save_vlfr(path: str, region: RegionFeatures) -> None:
-    """Packed binary: header(magic, n, d, w, h) + f32 features + f32 boxes."""
+    """Packed binary: header(magic, n, d, w, h) + f32 features + f32 boxes.
+
+    The format carries the SERVING fields only — ``cls_prob`` (the MRM
+    pretraining target) is dropped; a pretraining run against a .vlfr
+    store falls back to uniform targets, so warn when it's discarded here.
+    """
+    if region.cls_prob is not None:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            ".vlfr stores no cls_prob: %s loses the detector class "
+            "distribution — MRM pretraining against this store will use "
+            "uniform targets (keep the .npy for pretraining data)", path)
     feats = np.ascontiguousarray(region.features, dtype="<f4")
     boxes = np.ascontiguousarray(region.boxes, dtype="<f4")
     n, d = feats.shape

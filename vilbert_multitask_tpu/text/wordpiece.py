@@ -218,6 +218,10 @@ class FullTokenizer:
     def pad_id(self) -> int:
         return self.vocab[PAD]
 
+    @property
+    def mask_id(self) -> int:
+        return self.vocab[MASK]
+
 
 def demo_vocab(extra_words: Sequence[str] = ()) -> Dict[str, int]:
     """Self-contained vocabulary: specials, ascii chars, common-word stems and

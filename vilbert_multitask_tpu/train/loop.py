@@ -51,8 +51,75 @@ from vilbert_multitask_tpu.train.step import (
 
 # head → (serving task id, batch target keys). Task ids follow the demo's
 # dispatch table (config.TASK_REGISTRY; reference result.html:318-336).
+# "pretrain" is the Conceptual-Captions-style masked objective (the
+# ``BertForMultiModalPreTraining`` capability the reference imports and
+# never calls, worker.py:45); task token 0 is reserved for it.
 HEAD_TASK_IDS = {"vqa": 1, "gqa": 15, "tri": 13, "binary": 12,
-                 "grounding": 11, "retrieval": 7}
+                 "grounding": 11, "retrieval": 7, "pretrain": 0}
+
+# Heads that train as a GROUP under one compiled step (one LossConfig):
+# pretraining jointly optimizes masked-LM + masked-region prediction.
+HEAD_LOSS_GROUPS = {"pretrain": ("mlm", "mrm")}
+
+
+def apply_mlm_masking(input_ids: np.ndarray, input_mask: np.ndarray,
+                      rng, *, mask_id: int, vocab_size: int,
+                      special_ids: Sequence[int],
+                      mask_prob: float = 0.15) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+    """BERT dynamic masking: 15% of real, non-special positions; of those
+    80% → [MASK], 10% → random id, 10% → kept. Returns (masked_ids, labels)
+    with label -1 on unmasked positions (train/losses.py convention)."""
+    ids = input_ids.copy()
+    labels = np.full_like(ids, -1)
+    special = np.isin(ids, np.asarray(list(special_ids)))
+    candidates = (input_mask > 0) & ~special
+    pick = candidates & (rng.random(ids.shape) < mask_prob)
+    labels[pick] = ids[pick]
+    action = rng.random(ids.shape)
+    ids[pick & (action < 0.8)] = mask_id
+    rand_pos = pick & (action >= 0.8) & (action < 0.9)
+    ids[rand_pos] = rng.integers(0, vocab_size, int(rand_pos.sum()))
+    return ids, labels
+
+
+def apply_mrm_masking(regions, rng, *, n_classes: int, max_regions: int,
+                      mask_prob: float = 0.15):
+    """Masked-region modeling on RAW region sets, BEFORE encoding: ~15% of
+    each image's detector rows get their features zeroed, so the global
+    mean-pool row that ``encode_image`` prepends is computed over the
+    masked features (masking after pooling would leak every masked region
+    through row 0). The target is the detector's class distribution
+    (reference schema ``cls_prob``), or uniform when the store carries
+    none / the width disagrees with ``v_target_size``.
+
+    Returns (masked_regions, mrm_target (B, max_regions, C),
+    mrm_mask (B, max_regions)) — targets/mask aligned to the ENCODED
+    layout (row 0 = global, never masked).
+    """
+    masked, targets, masks = [], [], []
+    for r in regions:
+        n = int(r.num_boxes)
+        pick = rng.random((n,)) < mask_prob
+        feats = np.asarray(r.features[:n], np.float32).copy()
+        feats[pick] = 0.0
+        masked.append(dataclasses.replace(r, features=feats, num_boxes=n))
+        target = np.full((max_regions, n_classes), 1.0 / n_classes,
+                         np.float32)
+        cp = r.cls_prob
+        if cp is not None and cp.ndim == 2 and cp.shape[1] == n_classes:
+            k = min(cp.shape[0], n, max_regions - 1)
+            row_sum = np.clip(cp[:k].sum(axis=-1, keepdims=True), 1e-9, None)
+            target[1 : k + 1] = cp[:k] / row_sum
+        targets.append(target)
+        if n > max_regions - 1:
+            raise ValueError(
+                f"{n} regions exceed the {max_regions - 1} budget — run "
+                f"clip_regions before masking")
+        mask = np.zeros((max_regions,), np.float32)
+        mask[1 : n + 1] = pick.astype(np.float32)
+        masks.append(mask)
+    return masked, np.stack(targets), np.stack(masks)
 
 
 # ------------------------------------------------------------------ batching
@@ -68,20 +135,6 @@ def _text_batch(tokenizer, questions: Sequence[str], max_len: int,
         input_mask=np.stack([e.input_mask for e in enc]),
         task_ids=np.full((len(enc), 1), task_id, np.int32),
     )
-
-
-def _clip_regions(regions, max_regions: int):
-    """Clip over-provisioned feature rows to the region budget (confidence-
-    ordered stores may hold more than max_regions-1 boxes; same contract as
-    engine.prepare, runtime.py)."""
-    budget = max_regions - 1  # row 0 is the global feature
-    return [
-        dataclasses.replace(r, features=r.features[:budget],
-                            boxes=r.boxes[:budget],
-                            num_boxes=min(r.num_boxes, budget))
-        if r.num_boxes > budget else r
-        for r in regions
-    ]
 
 
 def _image_batch(regions, max_regions: int) -> Dict[str, np.ndarray]:
@@ -186,6 +239,15 @@ class SyntheticTaskData:
         elif h == "retrieval":
             if B % self.group_size:
                 raise ValueError("retrieval batch must divide group_size")
+        elif h == "pretrain":
+            labels = np.full((B, Nt), -1, np.int32)
+            pick = rng.random((B, Nt)) < 0.15
+            labels[pick] = rng.integers(
+                0, m.vocab_size, int(pick.sum())).astype(np.int32)
+            out["mlm_labels"] = labels
+            t = rng.random((B, Nv, m.v_target_size)).astype(np.float32)
+            out["mrm_target"] = t / t.sum(axis=-1, keepdims=True)
+            out["mrm_mask"] = (rng.random((B, Nv)) < 0.15).astype(np.float32)
         return out
 
 
@@ -197,13 +259,18 @@ class JsonlTaskData:
     tri:     {"premise"|"question", "image", "label": 0..2}
     binary:  {"caption", "images": [a, b], "label": bool}
     grounding: {"expression", "image", "gt_box": [x1, y1, x2, y2]}
+    pretrain: {"caption", "image"} — Conceptual-Captions-style pairs with
+              DYNAMIC masking per (seed, step): BERT 80/10/10 token masking
+              + ~15% region zeroing with the detector class distribution
+              (store ``cls_prob``) as the MRM target.
     """
 
     def __init__(self, head: str, jsonl_path: str, feature_store, tokenizer,
                  cfg: FrameworkConfig, *, label_map=None, seed: int = 0):
         from vilbert_multitask_tpu.evals.harness import load_jsonl
 
-        if head not in ("vqa", "gqa", "tri", "binary", "grounding"):
+        if head not in ("vqa", "gqa", "tri", "binary", "grounding",
+                        "pretrain"):
             raise ValueError(f"no JSONL loader for head {head!r}")
         self.head = head
         self.examples = load_jsonl(jsonl_path)
@@ -250,8 +317,21 @@ class JsonlTaskData:
             questions = [self._question_of(ex) for ex in exs]
             image_keys = [ex["image"] for ex in exs]
 
-        regions = _clip_regions(self.store.get_batch(image_keys),
-                                e.max_regions)
+        from vilbert_multitask_tpu.features.pipeline import clip_regions
+
+        regions = clip_regions(self.store.get_batch(image_keys),
+                               e.max_regions)
+        if h == "pretrain":
+            # Region masking happens BEFORE encoding: encode_image builds
+            # the global row 0 as the mean over region features, so masking
+            # the already-encoded batch would leak every masked region's
+            # content through the pool. Masking the raw rows first means
+            # the global mean sees zeros, like the reference regime.
+            rng = np.random.default_rng(
+                (self.seed, step, HEAD_TASK_IDS[h], 1))
+            regions, mrm_target, mrm_mask = apply_mrm_masking(
+                regions, rng, n_classes=m.v_target_size,
+                max_regions=e.max_regions)
         out = _text_batch(self.tokenizer, questions, e.max_text_len, task_id)
         out.update(_image_batch(regions, e.max_regions))
 
@@ -272,6 +352,19 @@ class JsonlTaskData:
                 iou_grounding_target(r.boxes, ex["gt_box"], r.num_boxes,
                                      e.max_regions)
                 for ex, r in zip(exs, regions)])
+        elif h == "pretrain":
+            # Region masking already happened pre-encoding (above); here
+            # only the text side masks, with the SAME per-step stream.
+            rng = np.random.default_rng(
+                (self.seed, step, HEAD_TASK_IDS[h], 2))
+            tok = self.tokenizer
+            specials = (tok.pad_id, tok.cls_id, tok.sep_id, tok.mask_id)
+            out["input_ids"], out["mlm_labels"] = apply_mlm_masking(
+                out["input_ids"], out["input_mask"], rng,
+                mask_id=tok.mask_id, vocab_size=m.vocab_size,
+                special_ids=specials)
+            out["mrm_target"] = mrm_target
+            out["mrm_mask"] = mrm_mask
         return out
 
 
@@ -472,7 +565,7 @@ class Trainer:
     def _step_for(self, head: str) -> Callable:
         if head not in self._steps:
             loss_cfg = LossConfig(
-                heads=(head,),
+                heads=HEAD_LOSS_GROUPS.get(head, (head,)),
                 retrieval_group_size=self.loop.retrieval_group_size)
             self._steps[head] = make_train_step(self.model, self.tx, loss_cfg)
         return self._steps[head]
@@ -614,6 +707,9 @@ def main(argv=None) -> None:
                       ckpt_every=args.ckpt_every, eval_every=args.eval_every,
                       warmup_steps=max(1, args.steps // 10))
     eval_fn = None
+    if args.eval_every and not args.data_root:
+        print("# --eval-every needs --data-root (eval_<task>.jsonl files); "
+              "no evals will run")
     if args.eval_every and args.data_root:
         from vilbert_multitask_tpu.evals.harness import Evaluator, load_jsonl
 

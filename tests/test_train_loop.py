@@ -426,3 +426,38 @@ def test_pretrain_jsonl_captions(train_cfg, tmp_path):
                 log_fn=lambda s: None)
     final = t.train()
     assert np.isfinite(final["loss/total"])
+
+
+def test_retrieval_jsonl_group_layout(train_cfg):
+    """Caption replicated over its group; the positive image occupies row 0
+    of each group (the contrastive-loss alignment convention)."""
+    from vilbert_multitask_tpu.features.store import FeatureStore
+    from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
+    from vilbert_multitask_tpu import assets
+
+    store = FeatureStore(os.path.join(GOLDEN, "features"))
+    tok = FullTokenizer.from_vocab_file(assets.default_vocab_path())
+    ds = JsonlTaskData("retrieval", os.path.join(GOLDEN, "retrieval.jsonl"),
+                       store, tok, train_cfg, group_size=2)
+    b = ds.batch(4, step=0)
+    assert b["input_ids"].shape[0] == 4  # 2 groups of 2
+    # caption rows within a group are identical
+    np.testing.assert_array_equal(b["input_ids"][0], b["input_ids"][1])
+    np.testing.assert_array_equal(b["input_ids"][2], b["input_ids"][3])
+    # positive-first: row 0 features come from the target image of the
+    # drawn example — compare against the store directly
+    from vilbert_multitask_tpu.evals.harness import load_jsonl
+    from vilbert_multitask_tpu.features.pipeline import encode_image
+
+    examples = load_jsonl(os.path.join(GOLDEN, "retrieval.jsonl"))
+    drawn = np.random.default_rng((0, 0, 7)).integers(0, len(examples), (2,))
+    ex0 = examples[drawn[0]]
+    pos = encode_image(store.get(ex0["images"][int(ex0["target"])]),
+                       train_cfg.engine.max_regions)
+    np.testing.assert_allclose(b["features"][0], pos.features, atol=1e-6)
+
+    t = Trainer(train_cfg, MultiTaskSampler({"retrieval": ds}),
+                _loop(2, log_every=1), log_fn=lambda s: None)
+    final = t.train()
+    assert np.isfinite(final["loss/total"])
+    assert "loss/retrieval" in final

@@ -89,7 +89,7 @@ def extract_one(raw_path: str, out_dir: str, fmt: str = "npy",
     features = np.asarray(raw["features"], np.float32)
     w, h = int(raw["image_width"]), int(raw["image_height"])
 
-    keep, num_valid, _conf, objects, cls_prob = select_regions(
+    keep, num_valid, _conf, objects, _max_conf = select_regions(
         boxes, cls_scores, num_keep=num_keep, iou_threshold=iou_threshold)
     n = int(min(num_valid, len(keep))) or 1  # at least one region
     keep = np.asarray(keep[:n])
@@ -101,9 +101,12 @@ def extract_one(raw_path: str, out_dir: str, fmt: str = "npy",
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, f"{key}.{fmt}")
     if fmt == "npy":
+        # cls_prob = the FULL per-region class distribution rows (reference
+        # schema; also the MRM pretraining target) — select_regions' last
+        # return is the per-box max confidence, a different quantity.
         save_reference_npy(out_path, region, key,
                            objects=np.asarray(objects[:n]),
-                           cls_prob=np.asarray(cls_prob[:n]))
+                           cls_prob=cls_scores[keep])
     elif fmt == "vlfr":
         save_vlfr(out_path, region)
     else:

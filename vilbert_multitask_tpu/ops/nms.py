@@ -75,10 +75,12 @@ def select_top_regions(
     the best score it achieved in any class where NMS kept it (and the score
     beat ``conf_threshold``); keep the ``num_keep`` highest. Returns
     ``(keep_indices (num_keep,), num_valid (), max_conf (N,), objects
-    (num_keep,), cls_prob (num_keep, C-start))`` where ``num_valid`` counts
-    kept boxes with nonzero confidence (worker.py:157) and ``objects`` /
-    ``cls_prob`` are the per-kept-box class argmax / score rows for the saved
-    ``.npy`` schema (worker.py:209-216).
+    (num_keep,), top_class_conf (num_keep,))`` where ``num_valid`` counts
+    kept boxes with nonzero confidence (worker.py:157), ``objects`` is the
+    per-kept-box class argmax, and ``top_class_conf`` its confidence — NOT
+    the full class-distribution rows; the saved-schema ``cls_prob``
+    (worker.py:209-216) is ``class_scores[keep_indices]``, which callers
+    take from their own scores array (features/extract.py).
 
     Note: the reference also derives ``objects``/``cls_prob`` for the saved
     schema with a row-slice quirk (``scores[keep_boxes][start_index:]`` drops

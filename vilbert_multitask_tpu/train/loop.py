@@ -187,8 +187,8 @@ def vqa_soft_target(answers: Sequence[str], ans2label: Dict[str, int],
 
 
 class SyntheticTaskData:
-    """Shape-correct random batches for one head — smoke tests, perf runs,
-    and the heads whose real datasets aren't wired (retrieval)."""
+    """Shape-correct random batches for one head — smoke tests and perf
+    runs (every head also has a real JSONL loader, JsonlTaskData)."""
 
     def __init__(self, head: str, cfg: FrameworkConfig, *, seed: int = 0,
                  group_size: int = 2):
@@ -238,7 +238,8 @@ class SyntheticTaskData:
             out["grounding_target"] = t / t.sum(axis=-1, keepdims=True)
         elif h == "retrieval":
             if B % self.group_size:
-                raise ValueError("retrieval batch must divide group_size")
+                raise ValueError(
+                    "retrieval batch must be divisible by group_size")
         elif h == "pretrain":
             labels = np.full((B, Nt), -1, np.int32)
             pick = rng.random((B, Nt)) < 0.15
@@ -263,15 +264,21 @@ class JsonlTaskData:
               DYNAMIC masking per (seed, step): BERT 80/10/10 token masking
               + ~15% region zeroing with the detector class distribution
               (store ``cls_prob``) as the MRM target.
+    retrieval: {"caption", "images": [...], "target": i} — the caption
+              replicates over ``group_size`` candidates (the positive at
+              row offset 0; the contrastive loss scores within the group,
+              train/losses.py retrieval_contrastive_loss).
     """
 
     def __init__(self, head: str, jsonl_path: str, feature_store, tokenizer,
-                 cfg: FrameworkConfig, *, label_map=None, seed: int = 0):
+                 cfg: FrameworkConfig, *, label_map=None, seed: int = 0,
+                 group_size: int = 2):
         from vilbert_multitask_tpu.evals.harness import load_jsonl
 
         if head not in ("vqa", "gqa", "tri", "binary", "grounding",
-                        "pretrain"):
+                        "pretrain", "retrieval"):
             raise ValueError(f"no JSONL loader for head {head!r}")
+        self.group_size = group_size
         self.head = head
         self.examples = load_jsonl(jsonl_path)
         if not self.examples:
@@ -299,12 +306,20 @@ class JsonlTaskData:
               ) -> Dict[str, np.ndarray]:
         m, e = self.cfg.model, self.cfg.engine
         h = self.head
-        n_logical = batch_size // 2 if h == "binary" else batch_size
+        if h == "binary":
+            n_logical = batch_size // 2
+        elif h == "retrieval":
+            if batch_size % self.group_size:
+                raise ValueError(
+                    f"retrieval batch {batch_size} must be divisible by "
+                    f"group_size {self.group_size}")
+            n_logical = batch_size // self.group_size
+        else:
+            n_logical = batch_size
         # Stateless draw keyed by the global step (exact resume); task id
         # decorrelates from the sampler's head-selection stream.
-        idx = np.random.default_rng(
-            (self.seed, step, HEAD_TASK_IDS[h])).integers(
-            0, len(self.examples), (n_logical,))
+        rng_idx = np.random.default_rng((self.seed, step, HEAD_TASK_IDS[h]))
+        idx = rng_idx.integers(0, len(self.examples), (n_logical,))
         exs = [self.examples[i] for i in idx]
         task_id = HEAD_TASK_IDS[h]
 
@@ -313,6 +328,24 @@ class JsonlTaskData:
             for ex in exs:
                 questions.extend([self._question_of(ex)] * 2)
                 image_keys.extend(ex["images"][:2])
+        elif h == "retrieval":
+            # Per caption: the positive image FIRST (loss convention:
+            # retrieval_contrastive_loss scores index 0 as aligned), then
+            # group_size-1 distractors drawn from the other candidates.
+            questions, image_keys = [], []
+            for ex in exs:
+                imgs = list(ex["images"])
+                pos = int(ex.get("target", 0))
+                distract = [k for j, k in enumerate(imgs) if j != pos]
+                need = self.group_size - 1
+                if len(distract) < need:
+                    raise ValueError(
+                        f"retrieval example needs ≥{self.group_size} images")
+                picks = list(rng_idx.choice(len(distract), size=need,
+                                            replace=False))
+                questions.extend([self._question_of(ex)] * self.group_size)
+                image_keys.append(imgs[pos])
+                image_keys.extend(distract[j] for j in picks)
         else:
             questions = [self._question_of(ex) for ex in exs]
             image_keys = [ex["image"] for ex in exs]
@@ -329,6 +362,19 @@ class JsonlTaskData:
             # the global mean sees zeros, like the reference regime.
             rng = np.random.default_rng(
                 (self.seed, step, HEAD_TASK_IDS[h], 1))
+            if not getattr(self, "_warned_uniform_mrm", False):
+                bad = sum(1 for r in regions
+                          if r.cls_prob is None or r.cls_prob.ndim != 2
+                          or r.cls_prob.shape[1] != m.v_target_size)
+                if bad:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "%d/%d sampled images carry no usable cls_prob "
+                        "(need (N, %d)); their MRM targets fall back to "
+                        "uniform — detector supervision is lost for them",
+                        bad, len(regions), m.v_target_size)
+                    self._warned_uniform_mrm = True
             regions, mrm_target, mrm_mask = apply_mrm_masking(
                 regions, rng, n_classes=m.v_target_size,
                 max_regions=e.max_regions)
@@ -511,6 +557,17 @@ class Trainer:
         self.cfg, self.sampler, self.loop = cfg, sampler, loop
         self.out_dir, self.mesh, self.log = out_dir, mesh, log_fn
         self.eval_fn = eval_fn
+        # The contrastive loss reshapes by loop.retrieval_group_size; a
+        # dataset laying out a different group width would silently score
+        # distractors as positives — fail construction instead.
+        for head, ds in sampler.datasets.items():
+            ds_group = getattr(ds, "group_size", None)
+            if (head == "retrieval" and ds_group is not None
+                    and ds_group != loop.retrieval_group_size):
+                raise ValueError(
+                    f"retrieval dataset group_size={ds_group} != "
+                    f"LoopConfig.retrieval_group_size="
+                    f"{loop.retrieval_group_size}")
         # Training computes in bf16 like serving; master params stay f32.
         self.model = ViLBertForVLTasks(
             dataclasses.replace(cfg.model,

@@ -461,3 +461,16 @@ def test_retrieval_jsonl_group_layout(train_cfg):
     final = t.train()
     assert np.isfinite(final["loss/total"])
     assert "loss/retrieval" in final
+
+
+def test_cli_main_synthetic_smoke(capsys):
+    """The module CLI end-to-end on synthetic data: one step, final JSON on
+    stdout (the `python -m vilbert_multitask_tpu.train.loop` contract)."""
+    from vilbert_multitask_tpu.train import loop as loop_mod
+
+    loop_mod.main(["--tiny", "--steps", "1", "--batch", "2",
+                   "--heads", "tri", "--log-every", "1"])
+    out = capsys.readouterr().out
+    final = json.loads(out.strip().splitlines()[-1])
+    assert np.isfinite(final["final"]["loss/total"])
+    assert final["final"]["step"] == 1

@@ -78,15 +78,18 @@ class LiveFeatureExtractor:
             self.params, jnp.asarray(padded),
             jnp.asarray([ph, pw], jnp.float32))
         boxes = np.asarray(boxes, np.float32)
-        keep, num_valid, _conf, _objects, _cls_prob = select_regions(
-            boxes, np.asarray(cls_scores, np.float32),
-            num_keep=self.num_keep)
+        cls_scores = np.asarray(cls_scores, np.float32)
+        # 5th return is per-box TOP-class confidence (ops/nms.py), not the
+        # class distribution — the schema cls_prob is the full score rows.
+        keep, num_valid, _conf, _objects, _max_conf = select_regions(
+            boxes, cls_scores, num_keep=self.num_keep)
         n = int(min(int(num_valid), len(keep))) or 1
         keep = np.asarray(keep[:n])
         return RegionFeatures(
             features=np.asarray(feats, np.float32)[keep],
             boxes=boxes[keep] / scale,  # back to original pixel coords
-            image_width=w, image_height=h, num_boxes=n)
+            image_width=w, image_height=h, num_boxes=n,
+            cls_prob=cls_scores[keep])
 
     def extract(self, image_path: str) -> RegionFeatures:
         from PIL import Image

@@ -474,3 +474,42 @@ def test_cli_main_synthetic_smoke(capsys):
     final = json.loads(out.strip().splitlines()[-1])
     assert np.isfinite(final["final"]["loss/total"])
     assert final["final"]["step"] == 1
+
+
+def test_trainer_aborts_on_divergence(train_cfg):
+    """A run that goes non-finite fails at the first logged step, not after
+    the remaining budget burns on NaN updates."""
+
+    class PoisonData(SyntheticTaskData):
+        def batch(self, batch_size, *, step=0):
+            b = super().batch(batch_size, step=step)
+            b["features"] = np.full_like(b["features"], np.nan)
+            return b
+
+    t = Trainer(train_cfg,
+                MultiTaskSampler({"vqa": PoisonData("vqa", train_cfg)}),
+                _loop(6, log_every=1), log_fn=lambda s: None)
+    with pytest.raises(FloatingPointError, match="non-finite loss at step 1"):
+        t.train()
+
+
+def test_trainer_never_snapshots_diverged_state(train_cfg, tmp_path):
+    """ckpt cadence ≠ log cadence: a NaN between log points must abort the
+    SAVE, never write a poisoned snapshot."""
+
+    class PoisonData(SyntheticTaskData):
+        def batch(self, batch_size, *, step=0):
+            b = super().batch(batch_size, step=step)
+            b["features"] = np.full_like(b["features"], np.nan)
+            return b
+
+    out = str(tmp_path / "ckpts")
+    t = Trainer(train_cfg,
+                MultiTaskSampler({"vqa": PoisonData("vqa", train_cfg)}),
+                _loop(4, log_every=100, ckpt_every=1),
+                out_dir=out, log_fn=lambda s: None)
+    with pytest.raises(FloatingPointError, match="snapshot NOT written"):
+        t.train()
+    snaps = ([n for n in os.listdir(out) if n.startswith("step_")]
+             if os.path.isdir(out) else [])
+    assert not snaps

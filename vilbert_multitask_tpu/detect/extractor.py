@@ -116,8 +116,10 @@ class FallbackFeatureStore:
         self.max_cached = max_cached
         from collections import OrderedDict
 
-        # LRU, same pattern as FeatureStore: ~0.8 MB per entry at the
-        # serving num_keep; unbounded growth would OOM a long-lived demo.
+        # LRU, same pattern as FeatureStore: ~1.5 MB per entry at the
+        # serving num_keep (fc6 features + the full cls_prob rows the MRM
+        # pretraining target needs); unbounded growth would OOM a
+        # long-lived demo.
         self._cache: "OrderedDict[str, RegionFeatures]" = OrderedDict()
         self._lock = threading.Lock()
 

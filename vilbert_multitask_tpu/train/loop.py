@@ -672,6 +672,14 @@ class Trainer:
                 if now % lp.log_every == 0 or now == lp.total_steps:
                     m = {k: round(float(v), 5)
                          for k, v in jax.device_get(metrics).items()}
+                    if not np.isfinite(m.get("loss/total", 0.0)):
+                        # Fail at the first logged divergence, not after the
+                        # remaining budget burns on NaN updates. The last
+                        # snapshot (≤ ckpt_every steps old) is the restart
+                        # point.
+                        raise FloatingPointError(
+                            f"non-finite loss at step {now} (head {head}): "
+                            f"{m}")
                     dt = time.perf_counter() - t0
                     m.update(step=now, head=head,
                              steps_per_s=round((now - window) / max(dt, 1e-9),
@@ -686,10 +694,16 @@ class Trainer:
                     self.log(json.dumps({"step": now, **scores}))
                 if self.out_dir and (now % lp.ckpt_every == 0
                                      or now == lp.total_steps):
+                    # Never snapshot a diverged state: ckpt and log cadences
+                    # differ, so the loss could have gone NaN since the last
+                    # logged check — a poisoned snapshot would defeat the
+                    # whole restart-point contract.
+                    loss_now = float(jax.device_get(metrics["loss/total"]))
+                    if not np.isfinite(loss_now):
+                        raise FloatingPointError(
+                            f"non-finite loss at step {now} (head {head}); "
+                            f"snapshot NOT written")
                     self._save(now)
-        if not np.isfinite(last_metrics.get("loss/total", 0.0)):
-            raise FloatingPointError(
-                f"non-finite loss at step {last_metrics.get('step')}")
         return last_metrics
 
 

@@ -29,6 +29,17 @@ Env knobs:
 - ``BENCH_PALLAS=0|1``  force the kernel path off/on in a child process
   (the orchestrator sets 0 for the compare child); unset → config defaults.
 - ``BENCH_ATTEMPTS`` / ``BENCH_ATTEMPT_TIMEOUT_S`` retry knobs.
+- ``BENCH_PROBE=0`` skip the pre-attempt backend probe (default ON for the
+  hardware path; TINY mode never probes). ``BENCH_PROBE_TIMEOUT_S`` (240),
+  ``BENCH_PROBE_BACKOFF_S`` (45) tune the probe cycle.
+- ``BENCH_WALL_BUDGET_S`` (3300) total wall budget for the orchestrator:
+  attempts are sized to fit what remains, and no attempt starts that cannot
+  finish inside it — a dead tunnel burns cheap probes, not 1800 s children.
+
+Kill-resilience: SIGTERM/SIGINT (what ``timeout`` sends before SIGKILL)
+emits the best-so-far JSON line — the headline measurement if one is in
+hand (e.g. killed mid-compare), else a structured failure with the probe
+log — so an outer rc=124 still leaves parseable evidence on stdout.
 """
 
 from __future__ import annotations
@@ -306,6 +317,16 @@ def run_measurement() -> None:
     }), flush=True)
 
 
+def _err_line(lines) -> str:
+    """Pick the most diagnostic stderr line: the actual error over the
+    boilerplate JAX appends after it ("frames removed" etc.)."""
+    lines = list(lines)
+    return next(
+        (ln for ln in reversed(lines)
+         if "Error" in ln or "error:" in ln.lower()),
+        lines[-1] if lines else "no stderr")
+
+
 def _run_child(timeout_s: float, extra_env: dict) -> tuple:
     """Run one measurement child; returns (json_line|None, err_text).
 
@@ -322,6 +343,10 @@ def _run_child(timeout_s: float, extra_env: dict) -> tuple:
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env={**os.environ, **extra_env},
     )
+    # Registered so the orchestrator's kill trap can take the child down
+    # with it — an orphaned measurement child would keep holding the TPU
+    # backend for up to its full attempt timeout.
+    _STATE["child"] = proc
     tail: collections.deque = collections.deque(maxlen=40)
     out_lines: list = []
     got_json = threading.Event()
@@ -368,18 +393,14 @@ def _run_child(timeout_s: float, extra_env: dict) -> tuple:
         time.sleep(0.5)
     for t in pumps:
         t.join(timeout=5)
+    _STATE["child"] = None
     # A line already on stdout is a valid measurement even if the child then
     # hung or died — never throw away a number in hand.
     json_line = next(
         (ln for ln in out_lines if ln.startswith('{"metric"')), None)
     if json_line:
         return json_line, ""
-    # Prefer the actual error line over boilerplate (JAX appends a "frames
-    # removed" notice AFTER the RuntimeError — tail[-1] alone is useless).
-    err_line = next(
-        (ln for ln in reversed(tail)
-         if "Error" in ln or "error:" in ln.lower()),
-        tail[-1] if tail else "no stderr")
+    err_line = _err_line(tail)
     if timed_out:
         err = f"exceeded {timeout_s:.0f}s; last: {err_line}"[:400]
     else:
@@ -387,7 +408,7 @@ def _run_child(timeout_s: float, extra_env: dict) -> tuple:
     return None, err
 
 
-def _maybe_compare(headline: dict) -> dict:
+def _maybe_compare(headline: dict, timeout_s: float | None = None) -> dict:
     """Kernel-on-vs-off delta for the headline JSON (BASELINE north star).
 
     Runs strictly AFTER the headline measurement is in hand, as a separate
@@ -401,7 +422,7 @@ def _maybe_compare(headline: dict) -> dict:
             and headline["value"] < COMPARE_MAX_P50_MS):
         return headline
     print("# compare child: XLA-attention engine...", file=sys.stderr)
-    line, err = _run_child(COMPARE_TIMEOUT_S,
+    line, err = _run_child(min(COMPARE_TIMEOUT_S, timeout_s or COMPARE_TIMEOUT_S),
                            {"BENCH_PALLAS": "0", "BENCH_COMPARE": "0"})
     if line is None:
         print(f"# compare child failed ({err}); headline unchanged",
@@ -423,44 +444,165 @@ def _maybe_compare(headline: dict) -> dict:
     return headline
 
 
-def main() -> None:
-    """Orchestrator: run the measurement in a subprocess, retry init flakes.
+def _probe_backend(timeout_s: float) -> tuple:
+    """Cheap liveness check: can a fresh interpreter see a backend at all?
 
-    The round-1 failure mode was a one-shot `RuntimeError: Unable to
-    initialize backend 'axon'` killing the whole bench. Backend-init state
-    is process-global in JAX, so each attempt gets a fresh interpreter.
+    Costs ~3 s live / ~2 min on a hung tunnel — vs the 1800 s a full
+    measurement child burns discovering the same thing (the round-3 loss:
+    BENCH_r03.json is ``rc=124, parsed:null`` because every retry spent an
+    attempt-sized timeout on a dead tunnel). Returns (ok, diagnostic).
     """
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', d[0].platform, len(d), flush=True)")
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout_s:.0f}s"
+    dt = time.monotonic() - t0
+    if r.returncode == 0 and "PROBE_OK" in r.stdout:
+        return True, f"probe ok in {dt:.0f}s: {r.stdout.strip()[:120]}"
+    return False, (f"probe rc={r.returncode} in {dt:.0f}s: "
+                   f"{_err_line(r.stderr.splitlines())[:200]}")
+
+
+# Best-so-far state for the kill trap: ``best`` holds the headline JSON the
+# moment a measurement child produces one (even if the compare pass is still
+# running); the SIGTERM/SIGINT handler prints it — or a structured failure —
+# before dying, so an outer `timeout` kill still leaves evidence.
+_STATE = {"emitted": False, "best": None, "log": [], "t0": 0.0,
+          "child": None}
+
+
+def _emit_final(obj: dict) -> None:
+    if _STATE["emitted"]:
+        return
+    _STATE["emitted"] = True
+    print(json.dumps(obj), flush=True)
+
+
+def _on_kill_signal(signum, frame) -> None:
+    child = _STATE.get("child")
+    if child is not None and child.poll() is None:
+        child.kill()  # don't orphan a TPU-holding measurement child
+    if _STATE["best"] is not None:
+        best = dict(_STATE["best"])
+        best["killed_early"] = True
+        _emit_final(best)
+    else:
+        _emit_final({
+            "metric": "p50_latency_ms", "value": None, "unit": "ms",
+            "vs_baseline": None, "partial": True,
+            "error": (f"killed by signal {signum} after "
+                      f"{time.monotonic() - _STATE['t0']:.0f}s; "
+                      f"log: {' | '.join(_STATE['log'][-4:])}")[:600],
+        })
+    os._exit(1)
+
+
+def main() -> None:
+    """Orchestrator: probe the backend, then measure in a subprocess.
+
+    Failure history this guards against: round 1 died one-shot on backend
+    init (fix: fresh-interpreter retries); round 3 died rc=124 with nothing
+    on stdout because a dead tunnel ate full attempt timeouts until the
+    driver's outer kill (fix: cheap pre-attempt probes, attempts sized to
+    the remaining wall budget, and a kill trap that emits best-so-far JSON).
+    """
+    import signal
+
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "4"))
     timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1800"))
-    # Linear 90s*i backoff: with 4 fast-failing init attempts (~2-3 min
-    # each) the loop rides out ~20 min of tunnel outage; a longer outage
-    # needs BENCH_ATTEMPTS raised — full tens-of-minutes coverage is not
-    # guaranteed by the defaults.
+    probe_on = (not TINY
+                and os.environ.get("BENCH_PROBE", "1") not in ("", "0"))
+    probe_timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+    probe_backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF_S", "45"))
+    wall_budget_s = float(os.environ.get("BENCH_WALL_BUDGET_S", "3300"))
+    # Below this remaining-time floor a measurement attempt cannot plausibly
+    # finish (engine init alone is ~30 s + compile ~60 s + measure ~90 s,
+    # all behind a tunnel with minutes of jitter) — stop and report instead.
+    min_attempt_s = float(os.environ.get("BENCH_MIN_ATTEMPT_S", "300"))
     backoff_s = 90.0
-    last_err = "no attempts ran"
-    for i in range(1, attempts + 1):
-        print(f"# bench attempt {i}/{attempts}", file=sys.stderr)
-        json_line, err = _run_child(timeout_s, {})
+
+    _STATE["t0"] = time.monotonic()
+    signal.signal(signal.SIGTERM, _on_kill_signal)
+    signal.signal(signal.SIGINT, _on_kill_signal)
+
+    def remaining() -> float:
+        return wall_budget_s - (time.monotonic() - _STATE["t0"])
+
+    def note(msg: str) -> None:
+        _STATE["log"].append(msg)
+        print(f"# {msg}", file=sys.stderr)
+
+    attempt = 0
+    while attempt < attempts:
+        # Probe cycle: spin on cheap probes while the backend is dead —
+        # never launch a child that will burn an attempt timeout learning
+        # what a probe learns in seconds.
+        while probe_on:
+            ok, diag = _probe_backend(min(probe_timeout_s, max(
+                remaining() - min_attempt_s, 10.0)))
+            note(diag)
+            if ok:
+                break
+            if remaining() < min_attempt_s + probe_backoff_s:
+                _emit_final({
+                    "metric": "p50_latency_ms", "value": None, "unit": "ms",
+                    "vs_baseline": None,
+                    "error": ("backend never came up within wall budget "
+                              f"({wall_budget_s:.0f}s); probes: "
+                              + " | ".join(_STATE["log"][-6:]))[:800],
+                })
+                sys.exit(1)
+            time.sleep(probe_backoff_s)
+        # +60 s drain margin: the child is sized to remaining()-60, so this
+        # gate guarantees child_timeout >= min_attempt_s — never a doomed
+        # (or negative-deadline) attempt on scraps of budget.
+        if remaining() < min_attempt_s + 60.0:
+            break
+        attempt += 1
+        # Size the child to what's left: a kill from our own deadline beats
+        # a kill from the driver's (ours leaves a diagnosed attempt, the
+        # driver's leaves rc=124).
+        child_timeout = min(timeout_s, remaining() - 60.0)
+        note(f"bench attempt {attempt}/{attempts} "
+             f"(timeout {child_timeout:.0f}s)")
+        json_line, err = _run_child(child_timeout, {})
         if json_line:
             try:
-                headline = _maybe_compare(json.loads(json_line))
-                print(json.dumps(headline), flush=True)
+                headline = json.loads(json_line)
             except ValueError:
-                print(json_line,
-                      end="" if json_line.endswith("\n") else "\n")
+                # e.g. a deadline kill truncated the line mid-write; the
+                # remaining attempts/budget may still produce a clean one.
+                note(f"attempt {attempt} emitted unparseable JSON: "
+                     f"{json_line[:200]}")
+                continue
+            _STATE["best"] = headline  # number in hand — survives any kill
+            if remaining() > 120.0:
+                headline = _maybe_compare(headline,
+                                          timeout_s=remaining() - 30.0)
+                _STATE["best"] = headline
+            else:
+                note("skipping compare pass: wall budget nearly spent")
+            _emit_final(headline)
             return
-        last_err = f"attempt {i} {err}"
-        print(f"# {last_err}", file=sys.stderr)
-        if i < attempts:
-            time.sleep(backoff_s * i)
-    # Total failure: still one parseable JSON line, now carrying diagnostics.
-    print(json.dumps({
+        note(f"attempt {attempt} {err}")
+        if attempt < attempts and remaining() > min_attempt_s + backoff_s:
+            time.sleep(min(backoff_s * attempt,
+                           max(remaining() - min_attempt_s, 0.0)))
+    # Total failure: still one parseable JSON line, carrying diagnostics.
+    _emit_final({
         "metric": "p50_latency_ms",
         "value": None,
         "unit": "ms",
         "vs_baseline": None,
-        "error": last_err,
-    }))
+        "error": (f"no measurement within budget; log: "
+                  + " | ".join(_STATE["log"][-6:]))[:800],
+    })
     sys.exit(1)
 
 

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Watch the TPU tunnel; the moment a probe succeeds, run the full bench and
+# capture the JSON + stderr log. Loops until a bench JSON with a non-null
+# value exists or the watcher is killed. Round-4 driver aid: the round-3
+# bench artifact was lost to a tunnel outage (VERDICT r3 §weak-1).
+set -u
+OUT=${1:-/root/repo/.bench_r04}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-240}
+SLEEP=${SLEEP:-300}
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  if timeout "$PROBE_TIMEOUT" python -c "import jax; d=jax.devices(); print(d)" >"$OUT.probe" 2>&1; then
+    echo "[$ts] PROBE_OK: $(cat "$OUT.probe" | tail -1)"
+    echo "[$ts] launching bench..."
+    python /root/repo/bench.py >"$OUT.json" 2>"$OUT.stderr"
+    rc=$?
+    echo "[$(date -u +%H:%M:%S)] bench rc=$rc json=$(cat "$OUT.json" 2>/dev/null | tail -1 | head -c 400)"
+    if python -c "import json,sys; d=json.load(open('$OUT.json')); sys.exit(0 if d.get('value') is not None else 1)" 2>/dev/null; then
+      echo "DONE: non-null bench value captured"
+      exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] bench value null; re-watching"
+  else
+    echo "[$ts] probe dead: $(tail -1 "$OUT.probe" | head -c 200)"
+  fi
+  sleep "$SLEEP"
+done

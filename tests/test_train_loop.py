@@ -149,6 +149,15 @@ def test_jsonl_datasets_golden_fixtures(train_cfg):
     # pair rows share their caption tokens
     np.testing.assert_array_equal(nb["input_ids"][0], nb["input_ids"][1])
 
+    # Contract errors, not silent misbehavior: an odd NLVR2 batch would
+    # silently emit batch_size-1 rows (and break dp divisibility on a
+    # mesh); vqa/gqa without a label map would train on all-zero targets.
+    with pytest.raises(ValueError, match="even"):
+        nlvr.batch(5, step=0)
+    with pytest.raises(ValueError, match="label_map"):
+        JsonlTaskData("vqa", os.path.join(GOLDEN, "vqa.jsonl"), store, tok,
+                      train_cfg)
+
 
 def test_jsonl_end_to_end_training_step(train_cfg):
     from vilbert_multitask_tpu.features.store import FeatureStore

@@ -161,8 +161,9 @@ class InferenceEngine:
             params = jax.device_put(params)
         jax.block_until_ready(params)
         self.params = params
-        # keyed (bucket, collect_attention, model_gen) — see _forward
-        self._compiled: Dict[Tuple[int, bool, int], callable] = {}
+        # keyed ('batched'|'rows', bucket, collect_attention, model_gen) —
+        # see _forward / _forward_rows
+        self._compiled: Dict[Tuple[str, int, bool, int], callable] = {}
         self.stage_times: Dict[str, float] = {}
         # Set by the first forward if Mosaic rejected the Pallas kernels on
         # this backend and the engine degraded to the XLA attention path.

@@ -291,6 +291,12 @@ class JsonlTaskData:
         self.ans2label: Dict[str, int] = {}
         if label_map is not None:
             self.ans2label = {a: i for i, a in enumerate(label_map)}
+        if head in ("vqa", "gqa") and not self.ans2label:
+            # Without the map every soft target is all-zero and BCE just
+            # suppresses all logits — training runs but learns nothing.
+            raise ValueError(
+                f"head {head!r} needs a non-empty label_map "
+                "(answer-string → index); got none")
         self.seed = seed
 
     def __len__(self) -> int:
@@ -307,6 +313,11 @@ class JsonlTaskData:
         m, e = self.cfg.model, self.cfg.engine
         h = self.head
         if h == "binary":
+            if batch_size % 2:
+                # Same contract as SyntheticTaskData: silently dropping a
+                # row would also break dp-divisibility on a sharded mesh.
+                raise ValueError(
+                    f"NLVR2 batch {batch_size} must be even (2 images/row)")
             n_logical = batch_size // 2
         elif h == "retrieval":
             if batch_size % self.group_size:

@@ -455,18 +455,27 @@ def _probe_backend(timeout_s: float) -> tuple:
     code = ("import jax; d = jax.devices(); "
             "print('PROBE_OK', d[0].platform, len(d), flush=True)")
     t0 = time.monotonic()
+    # Popen (not subprocess.run) so the kill trap can reach a hung probe:
+    # an orphaned probe would keep re-attempting the backend handshake with
+    # no deadline — the same hazard as an orphaned measurement child.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    _STATE["child"] = proc
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout_s,
-        )
+        out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
         return False, f"probe hung >{timeout_s:.0f}s"
+    finally:
+        _STATE["child"] = None
     dt = time.monotonic() - t0
-    if r.returncode == 0 and "PROBE_OK" in r.stdout:
-        return True, f"probe ok in {dt:.0f}s: {r.stdout.strip()[:120]}"
-    return False, (f"probe rc={r.returncode} in {dt:.0f}s: "
-                   f"{_err_line(r.stderr.splitlines())[:200]}")
+    if proc.returncode == 0 and "PROBE_OK" in out:
+        return True, f"probe ok in {dt:.0f}s: {out.strip()[:120]}"
+    return False, (f"probe rc={proc.returncode} in {dt:.0f}s: "
+                   f"{_err_line(err.splitlines())[:200]}")
 
 
 # Best-so-far state for the kill trap: ``best`` holds the headline JSON the
@@ -582,7 +591,10 @@ def main() -> None:
                      f"{json_line[:200]}")
                 continue
             _STATE["best"] = headline  # number in hand — survives any kill
-            if remaining() > 120.0:
+            # Same plausibility floor as a fresh attempt: a compare child
+            # is a full measurement, so launching it with less than
+            # min_attempt_s of budget just delays the headline emit.
+            if remaining() > min_attempt_s + 60.0:
                 headline = _maybe_compare(headline,
                                           timeout_s=remaining() - 30.0)
                 _STATE["best"] = headline

@@ -360,6 +360,68 @@ def test_admin_browse_endpoints(stack):
         api.stop()
 
 
+def test_admin_edit_roundtrip(stack, tmp_path):
+    """Write surface of the admin (reference demo/admin.py:11-34): edit a
+    Tasks row and a QA answer over POST, get the change back on browse, and
+    keep the hand-edit across a store re-open (the boot reseed must leave
+    edited rows alone — Django admin edits persist across restarts)."""
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "edit probe", 1, "sockED"))
+    worker.step()
+    qa_id = store.recent(limit=1)[0]["id"]
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+
+        def post(path, payload):
+            conn.request("POST", path, body=json.dumps(payload),
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, json.loads(r.read())
+
+        st, body = post("/admin/tasks/1", {"name": "VQA (edited)",
+                                           "num_of_images_max": 3})
+        assert st == 200 and body["row"]["name"] == "VQA (edited)"
+        conn.request("GET", "/admin/tasks")
+        tasks = {t["unique_id"]: t
+                 for t in json.loads(conn.getresponse().read())["tasks"]}
+        assert tasks[1]["name"] == "VQA (edited)"
+        assert tasks[1]["num_of_images_max"] == 3
+
+        st, body = post(f"/admin/questionanswer/{qa_id}",
+                        {"answer_text": {"answers": [{"answer": "fixed"}]},
+                         "input_text": "edited question"})
+        assert st == 200
+        assert body["row"]["input_text"] == "edited question"
+        assert body["row"]["answer_text"]["answers"][0]["answer"] == "fixed"
+        assert "socket_id" not in body["row"]  # same scrub as browse
+
+        # Rejections: unknown field, ill-typed value, missing row — all
+        # bounce whole, nothing half-applies.
+        assert post("/admin/tasks/1", {"unique_id": 9})[0] == 400
+        assert post("/admin/tasks/1", {"num_of_images": "three"})[0] == 400
+        # inverted gating range would make the task unselectable forever
+        assert post("/admin/tasks/1", {"num_of_images_min": 5,
+                                       "num_of_images_max": 1})[0] == 400
+        assert post("/admin/tasks/1", {"num_of_images_min": 9})[0] == 400
+        assert post("/admin/tasks/999", {"name": "x"})[0] == 404
+        assert post(f"/admin/questionanswer/{qa_id}",
+                    {"socket_id": "steal"})[0] == 400
+        assert post("/admin/questionanswer/999999",
+                    {"input_text": "x"})[0] == 404
+    finally:
+        api.stop()
+
+    # Persistence across boots: re-opening the store reseeds the catalog
+    # from TASK_REGISTRY but must not clobber the edited row.
+    reopened = ResultStore(store.path)
+    t1 = reopened.get_task(1)
+    assert t1["name"] == "VQA (edited)"
+    assert t1["num_of_images_max"] == 3
+    assert reopened.get_task(15)["name"] != "VQA (edited)"  # others reseeded
+
+
 # ---------------------------------------------------------------- frontend
 def test_frontend_served_to_browsers(stack):
     """GET / with a browser Accept header returns the single-page app; API

@@ -53,16 +53,20 @@ class ResultStore:
                 "question_answers (queue_job_id) WHERE queue_job_id IS NOT NULL"
             )
             # In-code migration (component row 14): the min/max image-count
-            # columns drive the browser's task gating; older stores get them
-            # added in place.
-            for col in ("num_of_images_min", "num_of_images_max"):
+            # columns drive the browser's task gating; ``edited`` marks rows
+            # an admin changed by hand. Older stores get them added in place.
+            for col, decl in (("num_of_images_min", "INTEGER"),
+                              ("num_of_images_max", "INTEGER"),
+                              ("edited", "INTEGER DEFAULT 0")):
                 try:
-                    c.execute(f"ALTER TABLE tasks ADD COLUMN {col} INTEGER")
+                    c.execute(f"ALTER TABLE tasks ADD COLUMN {col} {decl}")
                 except sqlite3.OperationalError:
                     pass  # already present
             # Seed/refresh the task catalog from the typed registry (replaces
-            # the reference's hand-entered admin rows, demo/models.py:4-20);
-            # the registry is the source of truth on every boot.
+            # the reference's hand-entered admin rows, demo/models.py:4-20).
+            # The registry is the source of truth on boot — EXCEPT for rows
+            # an admin edited (reference parity: Django admin edits persist
+            # across restarts, demo/admin.py:11-21).
             for spec in TASK_REGISTRY.values():
                 c.execute(
                     "INSERT INTO tasks (unique_id, name, placeholder, "
@@ -73,7 +77,8 @@ class ResultStore:
                     "description=excluded.description, "
                     "num_of_images=excluded.num_of_images, "
                     "num_of_images_min=excluded.num_of_images_min, "
-                    "num_of_images_max=excluded.num_of_images_max",
+                    "num_of_images_max=excluded.num_of_images_max "
+                    "WHERE COALESCE(tasks.edited, 0)=0",
                     (spec.task_id, spec.name, spec.placeholder,
                      spec.description, spec.max_images, spec.min_images,
                      spec.max_images),
@@ -104,6 +109,56 @@ class ResultStore:
                 "ORDER BY unique_id"
             ).fetchall()
         return [dict(zip(self._TASK_COLS, r)) for r in rows]
+
+    # The admin's writable surface (reference demo/admin.py:11-21: Django
+    # TaskAdmin exposes exactly the catalog fields for editing). unique_id
+    # is the registry key and stays immutable.
+    _TASK_EDITABLE = {"name", "placeholder", "description", "num_of_images",
+                      "num_of_images_min", "num_of_images_max"}
+    _TASK_INT_FIELDS = {"num_of_images", "num_of_images_min",
+                        "num_of_images_max"}
+
+    def update_task(self, task_id: int,
+                    fields: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Admin edit of a catalog row; marks it ``edited`` so the boot-time
+        registry reseed leaves it alone. Returns the updated row, or None if
+        the task doesn't exist. Raises ValueError on unknown/ill-typed
+        fields — admin typos should bounce, not half-apply."""
+        unknown = set(fields) - self._TASK_EDITABLE
+        if unknown or not fields:
+            raise ValueError(
+                f"editable fields are {sorted(self._TASK_EDITABLE)}; "
+                f"got {sorted(fields) or 'nothing'}")
+        clean: Dict[str, Any] = {}
+        for k, v in fields.items():
+            if k in self._TASK_INT_FIELDS:
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    raise ValueError(f"{k} must be a non-negative int")
+            elif not isinstance(v, str):
+                raise ValueError(f"{k} must be a string")
+            clean[k] = v
+        current = self.get_task(task_id)
+        if current is None:
+            return None
+        # Cross-field sanity on the merged row: an inverted min/max range
+        # would make the task unselectable in the browser's gating — and
+        # edited=1 means the boot reseed would never repair it.
+        merged = {**current, **clean}
+        lo = merged.get("num_of_images_min")
+        hi = merged.get("num_of_images_max")
+        if lo is not None and hi is not None and lo > hi:
+            raise ValueError(
+                f"num_of_images_min ({lo}) > num_of_images_max ({hi})")
+        with self._conn() as c:
+            cur = c.execute(
+                "UPDATE tasks SET "
+                + ", ".join(f"{k}=?" for k in clean)
+                + ", edited=1 WHERE unique_id=?",
+                (*clean.values(), task_id),
+            )
+            if cur.rowcount == 0:
+                return None
+        return self.get_task(task_id)
 
     # --------------------------------------------------------------- QA rows
     def create_question(self, task_id: int, input_text: str,
@@ -162,6 +217,40 @@ class ResultStore:
                 (qa_id,),
             ).fetchone()
         return None if row is None else self._qa_row(row)
+
+    def update_question(self, qa_id: int,
+                        fields: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Admin correction of an audit row (reference demo/admin.py:24-34:
+        QuestionAnswer is registered in the Django admin, so its text fields
+        are editable there). Only the human-readable text fields are open;
+        images/socket/job linkage stay immutable. Returns the updated row
+        (scrub socket_id at the API layer), None if the row doesn't exist."""
+        editable = {"input_text", "answer_text"}
+        unknown = set(fields) - editable
+        if unknown or not fields:
+            raise ValueError(
+                f"editable fields are {sorted(editable)}; "
+                f"got {sorted(fields) or 'nothing'}")
+        sets, vals = [], []
+        if "input_text" in fields:
+            if not isinstance(fields["input_text"], str):
+                raise ValueError("input_text must be a string")
+            sets.append("input_text=?")
+            vals.append(fields["input_text"])
+        if "answer_text" in fields:
+            # Stored as JSON, same as save_answer — accepts the same shapes
+            # the decode families emit (dict/list/str).
+            sets.append("answer_text=?")
+            vals.append(json.dumps(fields["answer_text"]))
+        with self._conn() as c:
+            cur = c.execute(
+                f"UPDATE question_answers SET {', '.join(sets)}, "
+                "modified_at=? WHERE id=?",
+                (*vals, time.time(), qa_id),
+            )
+            if cur.rowcount == 0:
+                return None
+        return self.get_question(qa_id)
 
     def recent(self, limit: int = 50) -> List[Dict[str, Any]]:
         """Latest jobs, newest first (the admin list view's read,

@@ -319,8 +319,48 @@ class ApiServer:
                     self._handle_upload(raw, ctype)
                 elif path.startswith("/worker/"):
                     self._handle_worker(path, raw)
+                elif path.startswith("/admin/"):
+                    self._handle_admin_edit(path, raw)
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _handle_admin_edit(self, path: str, raw: bytes):
+                """Admin write surface (reference demo/admin.py:11-34: the
+                Django admin edits Tasks rows and QuestionAnswer text).
+                POST /admin/tasks/<id> and /admin/questionanswer/<id> take a
+                JSON object of editable fields and return the updated row
+                with the same scrubbing the browse endpoints apply."""
+                parts = path.strip("/").split("/")
+                if len(parts) != 3 or parts[1] not in (
+                        "tasks", "questionanswer"):
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    row_id = int(parts[2])
+                except ValueError:
+                    self._json(400, {"error": "bad id"})
+                    return
+                try:
+                    fields = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    self._json(400, {"error": "invalid JSON"})
+                    return
+                if not isinstance(fields, dict):
+                    self._json(400, {"error": "body must be a JSON object"})
+                    return
+                try:
+                    if parts[1] == "tasks":
+                        row = api.store.update_task(row_id, fields)
+                    else:
+                        row = api.store.update_question(row_id, fields)
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                if row is None:
+                    self._json(404, {"error": f"no row {row_id}"})
+                    return
+                row.pop("socket_id", None)  # same scrub as the browse view
+                self._json(200, {"row": row})
 
             def _handle_worker(self, path: str, raw: bytes):
                 """Network face of the queue/store/hub for remote workers

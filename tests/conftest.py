@@ -52,6 +52,11 @@ def tiny_framework_cfg(tmp_path_factory):
         engine=EngineConfig(
             max_text_len=12, max_regions=9, num_features=8,
             image_buckets=(1, 2, 4, 8), compute_dtype="float32",
+            # Keep the serving fixtures on the image buckets alone: the
+            # default 16/32-row throughput buckets would add two more
+            # compiles to every batching test. Their behavior has a
+            # dedicated test (test_batching.py::test_throughput_bucket_chunking).
+            throughput_buckets=None,
             # XLA attention here: these fixtures exercise the serving tiers,
             # not the kernel, and interpret-mode Pallas makes CPU forwards
             # ~10x slower. Kernel coverage lives in test_pallas_coattention.

@@ -57,6 +57,49 @@ def test_run_many_chunks_beyond_max_bucket(engine):
     assert all(r.kind == "labels" for r in results)
 
 
+def test_throughput_bucket_chunking(tiny_framework_cfg, features_dir):
+    """run_many chunks at the throughput bucket (not the max image bucket)
+    when one is configured, produces the same decodes, and honors the
+    chunk_rows override; row_bucket_for folds the extra bucket in."""
+    import dataclasses
+
+    from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+    from vilbert_multitask_tpu.features.store import FeatureStore
+
+    cfg = dataclasses.replace(
+        tiny_framework_cfg,
+        engine=dataclasses.replace(
+            tiny_framework_cfg.engine,
+            image_buckets=(1, 2, 4), throughput_buckets=(8,)),
+    )
+    assert cfg.engine.row_bucket_for(5) == 8
+    assert cfg.engine.bucket_for(4) == 4  # image-axis semantics unchanged
+    with pytest.raises(ValueError, match="row bucket"):
+        cfg.engine.row_bucket_for(9)
+
+    eng = InferenceEngine(cfg, feature_store=FeatureStore(features_dir))
+    reqs = [
+        _prep(eng, 1, f"question {i}", [("img_a.jpg", "img_b.jpg")[i % 2]])
+        for i in range(6)
+    ]
+    batched = eng.run_many(reqs)  # one 8-row chunk (6 rows + 2 pad)
+    assert len(batched) == 6
+    solo_answers = []
+    for r in reqs:
+        _, s = eng.run(r)
+        solo_answers.append([a["answer"] for a in s.answers])
+    assert [[a["answer"] for a in b.answers] for b in batched] == solo_answers
+    # Override back to the image buckets: two chunks of 4 — identical output.
+    chunked = eng.run_many(reqs, chunk_rows=4)
+    assert [[a["answer"] for a in b.answers]
+            for b in chunked] == solo_answers
+    with pytest.raises(ValueError, match="row bucket"):
+        eng.run_many(reqs, chunk_rows=16)
+    for bad in (0, -4):  # must error, never silently drop requests
+        with pytest.raises(ValueError, match=">=1"):
+            eng.run_many(reqs, chunk_rows=bad)
+
+
 def test_prepare_clips_oversized_feature_files(engine):
     """Feature files with more boxes than the engine's region budget clip to
     the top-N (files are confidence-ordered) instead of erroring."""

@@ -237,6 +237,14 @@ class EngineConfig:
     # Static shape buckets for the image axis: NLVR2 needs 2, retrieval 2..10
     # (worker.py:256-284). Each bucket compiles once.
     image_buckets: Sequence[int] = (1, 2, 4, 8, 10)
+    # Row buckets used ONLY by run_many's chunking (the queue-backlog
+    # batched path). The image buckets top out at 10 for retrieval
+    # semantics, which caps batched MFU near 0.5%; throughput-sized chunks
+    # keep the MXU fed — one batch-32 forward is ~0.8 TFLOP of real work
+    # per dispatch. The intermediate 16 keeps mid-size batches (11-31 rows)
+    # off the 32-row padding cliff. None/() → chunk at max(image_buckets)
+    # (the round-3 behavior).
+    throughput_buckets: Sequence[int] | None = (16, 32)
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
     param_dtype: str = "float32"
     # Default ON (round 3): serving runs the flash co-attention kernel on
@@ -274,6 +282,30 @@ class EngineConfig:
             if n_images <= b:
                 return b
         raise ValueError(f"no shape bucket holds {n_images} images")
+
+    def all_row_buckets(self) -> list:
+        """Every compiled row count serving can dispatch: the image buckets
+        (run()) plus the throughput buckets (run_many), sorted. The single
+        source for warmup coverage and chunk-fitting."""
+        return sorted({*self.image_buckets,
+                       *(self.throughput_buckets or ())})
+
+    def row_bucket_for(self, n_rows: int) -> int:
+        """Smallest compiled row count that fits a run_many chunk (batched
+        rows are independent single-image requests, so the image-axis
+        semantics of bucket_for don't constrain them)."""
+        if n_rows < 1:
+            raise ValueError(f"row count must be >=1, got {n_rows}")
+        for b in self.all_row_buckets():
+            if n_rows <= b:
+                return b
+        raise ValueError(f"no row bucket holds {n_rows} rows")
+
+    def max_batch_rows(self) -> int:
+        """Largest compiled row count — run_many's chunk size and the
+        natural drain depth for a backlogged worker."""
+        return max(max(self.image_buckets),
+                   *(self.throughput_buckets or (0,)))
 
 
 @dataclasses.dataclass(frozen=True)

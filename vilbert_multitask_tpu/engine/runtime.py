@@ -433,7 +433,12 @@ class InferenceEngine:
         (the first failing thread degrades under a lock; others retry on
         the rebuilt XLA model).
         """
-        buckets = list(buckets or self.cfg.engine.image_buckets)
+        # Default set covers everything serving dispatches: the image
+        # buckets (run()) AND the throughput buckets (run_many under
+        # backlog) — otherwise the first big batch stalls on a mid-serving
+        # compile, breaking this method's contract.
+        buckets = list(buckets if buckets is not None
+                       else self.cfg.engine.all_row_buckets())
         if parallel is None:
             parallel = self.cfg.engine.parallel_warmup
 
@@ -688,7 +693,8 @@ class InferenceEngine:
         return out, result
 
     def run_many(
-        self, reqs: Sequence[PreparedRequest]
+        self, reqs: Sequence[PreparedRequest], *,
+        chunk_rows: Optional[int] = None,
     ) -> List[dec.TaskResult]:
         """Cross-task micro-batching: many single-image requests, ONE forward.
 
@@ -718,7 +724,16 @@ class InferenceEngine:
         # HBM at once.
         from collections import deque
 
-        max_bucket = max(self.cfg.engine.image_buckets)
+        # Chunk at the largest throughput bucket when configured: batched
+        # rows are independent single-image requests, so the 10-row
+        # retrieval cap on the image buckets doesn't apply — a 32-row chunk
+        # keeps the MXU fed instead of paying a dispatch round trip per 10
+        # rows (mid-size tails land on the intermediate buckets).
+        # ``chunk_rows`` overrides for callers tuning backlog shape (and
+        # the bench's 10-vs-32 comparison); it must fit a compiled bucket.
+        max_bucket = (chunk_rows if chunk_rows is not None
+                      else self.cfg.engine.max_batch_rows())
+        self.cfg.engine.row_bucket_for(max_bucket)  # raises on <1 or misfit
         chunks = [reqs[i : i + max_bucket]
                   for i in range(0, len(reqs), max_bucket)]
         out: List[dec.TaskResult] = []
@@ -756,7 +771,7 @@ class InferenceEngine:
         """Pack one ≤max-bucket chunk and dispatch its forward; returns the
         un-fetched device decode bundle."""
         n = len(reqs)
-        bucket = self.cfg.engine.bucket_for(n)
+        bucket = self.cfg.engine.row_bucket_for(n)
         pad = bucket - n
 
         def pack(rows, pad_row):

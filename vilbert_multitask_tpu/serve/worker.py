@@ -167,7 +167,7 @@ class ServeWorker:
             self.metrics.record_failure()
 
     # ------------------------------------------------------- micro-batching
-    def step_batch(self, max_jobs: int = 8) -> int:
+    def step_batch(self, max_jobs: Optional[int] = None) -> int:
         """Drain up to ``max_jobs`` queued jobs and serve the packable
         single-image ones in ONE forward (engine.run_many); multi-image jobs
         claimed along the way run individually. Returns jobs completed.
@@ -176,6 +176,11 @@ class ServeWorker:
         serial batch=1 loop (worker.py:70,489,672-673): under queue backlog
         the trunk runs once per bucket instead of once per request.
         """
+        if max_jobs is None:
+            # Drain to the engine's largest compiled row bucket: under deep
+            # backlog the worker fills a whole throughput chunk (32 by
+            # default) instead of capping at 8 and leaving the MXU starved.
+            max_jobs = self.engine.cfg.engine.max_batch_rows()
         singles: List[tuple] = []  # (job, qa_id, prepared, t0)
         done = 0
         failed_ids: set = set()
@@ -289,8 +294,10 @@ class ServeWorker:
         return "acked"
 
     def run_forever(self, *, poll_interval_s: float = 0.05,
-                    stop_event=None, batch_jobs: int = 8) -> None:
-        """The consume loop (reference worker.py:672-673), micro-batched."""
+                    stop_event=None, batch_jobs: Optional[int] = None) -> None:
+        """The consume loop (reference worker.py:672-673), micro-batched;
+        ``batch_jobs`` defaults to the engine's largest compiled row bucket
+        (see step_batch)."""
         while stop_event is None or not stop_event.is_set():
             if self.step_batch(batch_jobs) == 0:
                 time.sleep(poll_interval_s)

@@ -17,6 +17,16 @@ while true; do
     echo "[$(date -u +%H:%M:%S)] bench rc=$rc json=$(cat "$OUT.json" 2>/dev/null | tail -1 | head -c 400)"
     if python -c "import json,sys; d=json.load(open('$OUT.json')); sys.exit(0 if d.get('value') is not None else 1)" 2>/dev/null; then
       echo "DONE: non-null bench value captured"
+      echo "[$(date -u +%H:%M:%S)] train smoke (50 tiny steps)..."
+      timeout 1800 python /root/repo/scripts/tpu_train_smoke.py --steps 50 \
+        --out /root/repo/TRAIN_SMOKE_r04.json >"$OUT.train" 2>&1 \
+        && echo "train smoke ok: $(tail -1 "$OUT.train" | head -c 300)" \
+        || echo "train smoke FAILED rc=$? (see $OUT.train)"
+      echo "[$(date -u +%H:%M:%S)] live-extractor bench (full canvas)..."
+      timeout 1800 python /root/repo/scripts/tpu_detect_bench.py \
+        --out /root/repo/DETECT_BENCH_r04.json >"$OUT.detect" 2>&1 \
+        && echo "detect bench ok: $(tail -1 "$OUT.detect" | head -c 300)" \
+        || echo "detect bench rc=$? (a recorded blowup is still a result; see $OUT.detect)"
       exit 0
     fi
     echo "[$(date -u +%H:%M:%S)] bench value null; re-watching"

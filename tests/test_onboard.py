@@ -1,0 +1,123 @@
+"""Rehearse the one-command real-asset onboarding flow (VERDICT r3 #4).
+
+The real deployment assets (pytorch_model_9.bin, the bert-base-uncased
+vocab, the answer-vocabulary pickles — reference worker.py:470,537-539,
+299-315) don't exist in this image, so the rehearsal uses faithful
+stand-ins: a genuinely torch-serialized ``.bin`` (DataParallel-prefixed,
+like the published file), the committed synthetic vocab, and a JSON label
+map written through LabelMapStore. The test proves a deployer can run ONE
+command and get a parity verdict — and that the verdict binds (a wrong
+expectation fails).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu import assets
+from vilbert_multitask_tpu.checkpoint import onboard
+from vilbert_multitask_tpu.checkpoint.convert import to_torch_state_dict
+from vilbert_multitask_tpu.config import FrameworkConfig
+from vilbert_multitask_tpu.engine.labels import LabelMapStore
+from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures", "golden")
+
+
+def _onboard_cfg(vocab_path, labels_root):
+    """Exactly the config ``onboard.main(--tiny --cpu)`` builds, so the
+    test's expectation engine and the CLI's engine share numerics."""
+    cfg = FrameworkConfig()
+    cfg = dataclasses.replace(cfg, model=cfg.model.tiny())
+    return dataclasses.replace(cfg, engine=dataclasses.replace(
+        cfg.engine, vocab_path=vocab_path, labels_root=labels_root,
+        compute_dtype="float32", use_pallas_coattention=False,
+        use_pallas_self_attention=False))
+
+
+def test_onboard_end_to_end(tmp_path, capsys):
+    torch = pytest.importorskip("torch")
+
+    vocab = assets.default_vocab_path()
+    labels_root = str(tmp_path / "labels")
+    cfg = _onboard_cfg(vocab, labels_root)
+    # Real label FILES (not the synthetic fallback): the rehearsal must
+    # walk the same load path the genuine pickles/JSON would.
+    store = LabelMapStore(labels_root, allow_synthetic=False)
+    store.save_json("vqa", [f"ans_{i}" for i in range(cfg.model.num_labels)])
+    store.save_json("gqa", [f"g_{i}"
+                            for i in range(cfg.model.gqa_num_labels)])
+
+    # The "published checkpoint" stand-in: a seeded engine's weights,
+    # torch-serialized with the DataParallel 'module.' prefixes the real
+    # pytorch_model_9.bin carries (reference worker.py:470).
+    src = InferenceEngine(cfg, seed=0)
+    sd = {f"module.{k}": torch.from_numpy(np.asarray(v))
+          for k, v in to_torch_state_dict(src.params, cfg.model).items()}
+    bin_path = str(tmp_path / "pytorch_model_9.bin")
+    torch.save(sd, bin_path)
+
+    # Expected scores, computed on the source engine through the same
+    # harness the CLI uses — what a deployer would paste from the paper.
+    from vilbert_multitask_tpu.evals.harness import Evaluator, load_jsonl
+    from vilbert_multitask_tpu.features.store import FeatureStore
+
+    src.feature_store = FeatureStore(os.path.join(GOLDEN, "features"))
+    vqa_res = Evaluator(src, batch=4).run(
+        "vqa", load_jsonl(os.path.join(GOLDEN, "vqa.jsonl")))
+    expect_path = str(tmp_path / "expected.json")
+    with open(expect_path, "w") as f:
+        json.dump({"vqa": {"accuracy": vqa_res["accuracy"]}}, f)
+
+    out_dir = str(tmp_path / "onboarded")
+    argv = ["--torch-bin", bin_path, "--vocab", vocab,
+            "--labels", labels_root, "--out", out_dir,
+            "--eval", f"vqa={os.path.join(GOLDEN, 'vqa.jsonl')}",
+            "--features", os.path.join(GOLDEN, "features"),
+            "--expect", expect_path, "--tol", "1e-9",
+            "--tiny", "--cpu"]
+    rc = onboard.main(argv)
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["ok"] is True
+    assert report["steps"]["convert"]["ok"]
+    assert report["steps"]["boot"]["vocab_tokens"] > 1000
+    assert report["steps"]["parity"]["failures"] == []
+    # Smoke answers decoded from the PROVIDED label files, not synthetics.
+    assert report["steps"]["smoke"]["tasks"]["1"]["top"].startswith("ans_")
+    # Converted params persisted through the production Orbax path.
+    assert os.path.isdir(report["steps"]["convert"]["params_dir"])
+    assert os.path.exists(os.path.join(out_dir, "report.json"))
+
+    # The verdict must bind: a wrong expectation → rc 1 with the miss
+    # named, and an expected task that was never evaluated is a failure
+    # too (not a silent pass).
+    with open(expect_path, "w") as f:
+        json.dump({"vqa": {"accuracy": vqa_res["accuracy"] + 0.25},
+                   "gqa": {"accuracy": 0.5}}, f)
+    rc = onboard.main(argv)
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["ok"] is False
+    fails = report["steps"]["parity"]["failures"]
+    assert any("vqa.accuracy" in f for f in fails)
+    assert any("gqa" in f and "never evaluated" in f for f in fails)
+
+
+def test_onboard_rejects_malformed_eval_spec(tmp_path):
+    with pytest.raises(SystemExit, match="TASK=DATA"):
+        onboard._parse_evals(["vqa:data.jsonl"])
+
+
+def test_onboard_uncovered_expectation_fails(tmp_path, capsys):
+    """An expected task with no matching --eval must fail, not silently
+    pass — 'exit 0' claims every expected score was reproduced."""
+    expect = tmp_path / "exp.json"
+    expect.write_text(json.dumps({"vqa": {"accuracy": 0.5},
+                                  "gqa": {"accuracy": 0.5}}))
+    with pytest.raises(SystemExit, match="verify nothing"):
+        onboard.main(["--torch-bin", "x.bin", "--vocab", "v", "--labels",
+                      "l", "--out", str(tmp_path), "--expect", str(expect)])

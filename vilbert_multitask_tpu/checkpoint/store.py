@@ -16,13 +16,17 @@ import jax
 import numpy as np
 
 
-def save_params(path: str, params: Any) -> None:
-    """Save a param pytree with Orbax (directory checkpoint)."""
+def save_params(path: str, params: Any, *, force: bool = False) -> None:
+    """Save a param pytree with Orbax (directory checkpoint).
+
+    ``force`` overwrites an existing checkpoint dir — re-runnable flows
+    (the onboarding CLI) replace their own output instead of erroring."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, jax.tree_util.tree_map(np.asarray, params))
+        ckptr.save(path, jax.tree_util.tree_map(np.asarray, params),
+                   force=force)
 
 
 def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:

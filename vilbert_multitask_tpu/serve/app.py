@@ -128,7 +128,7 @@ class ServeApp:
             self.boot_info["detector_warm"] = True
         self.boot_info.update(
             warmup_s=round(time.perf_counter() - t0, 1),
-            buckets=list(self.cfg.engine.image_buckets),
+            buckets=list(self.cfg.engine.all_row_buckets()),
             pallas=self.engine.pallas_enabled,
             kernel_fallback=self.engine.kernel_fallback,
         )

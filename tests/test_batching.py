@@ -34,10 +34,40 @@ def test_run_many_matches_individual_runs(engine):
                 [b["region_index"] for b in solo.boxes]
 
 
-def test_run_many_rejects_multi_image(engine):
-    req = _prep(engine, 12, "both", ["img_a.jpg", "img_b.jpg"])
-    with pytest.raises(ValueError, match="single-image"):
-        engine.run_many([req])
+def test_run_many_batches_multi_image(engine):
+    """NLVR2 pairs and retrieval candidate sets ride the batched path
+    (round-3 ceiling removed): results must match solo run() exactly, in
+    input order, with pair rows staying even-aligned inside chunks."""
+    reqs = [
+        _prep(engine, 12, "both show dogs", ["img_a.jpg", "img_b.jpg"]),
+        _prep(engine, 1, "what is this", ["img_a.jpg"]),
+        _prep(engine, 12, "both show cats", ["img_b.jpg", "img_a.jpg"]),
+        _prep(engine, 7, "a dog in snow",
+              ["img_a.jpg", "img_b.jpg", "img_a.jpg", "img_b.jpg"]),
+        _prep(engine, 12, "two wolves", ["img_a.jpg", "img_b.jpg"]),
+    ]
+    batched = engine.run_many(reqs)
+    assert [r.kind for r in batched] == ["binary", "labels", "binary",
+                                        "ranking", "binary"]
+    for req, got in zip(reqs, batched):
+        _, solo = engine.run(req)
+        if got.answers is not None:
+            assert [a["answer"] for a in got.answers] == \
+                [a["answer"] for a in solo.answers], req.spec.task_id
+            np.testing.assert_allclose(
+                [a["confidence"] for a in got.answers],
+                [a["confidence"] for a in solo.answers], atol=1e-4)
+        if got.ranking is not None:
+            assert [r["image"] for r in got.ranking] == \
+                [r["image"] for r in solo.ranking]
+
+
+def test_run_many_rejects_oversized_request(engine):
+    """A request wider than the chunk cannot pack — clear error."""
+    reqs = [_prep(engine, 7, "query",
+                  ["img_a.jpg", "img_b.jpg"] * 2)]
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.run_many(reqs, chunk_rows=2)
 
 
 def test_run_many_empty(engine):
@@ -134,6 +164,29 @@ def test_worker_step_batch_mixed_tasks(stack):
     by_task = {r["task_id"]: r for r in rows[:4]}
     assert by_task[12]["answer_text"]["kind"] == "binary"
     assert by_task[1]["answer_text"]["kind"] == "labels"
+
+
+def test_worker_batches_multi_image_jobs(stack, monkeypatch):
+    """NLVR2/retrieval jobs complete through run_many, never the solo
+    path: with engine.run() poisoned, a mixed drain must still finish
+    every job (round-3's known ceiling — multi-image jobs paid one
+    forward each — is gone)."""
+    s, hub, q, store, worker = stack
+
+    def _boom(*a, **k):
+        raise AssertionError("solo run() must not be used by step_batch")
+
+    monkeypatch.setattr(worker.engine, "run", _boom)
+    q.publish(make_job_message(["img_a.jpg", "img_b.jpg"], "both", 12, "b1"))
+    q.publish(make_job_message(["img_a.jpg"], "what", 1, "b2"))
+    q.publish(make_job_message(
+        ["img_a.jpg", "img_b.jpg", "img_a.jpg", "img_b.jpg"],
+        "a dog", 7, "b3"))
+    assert worker.step_batch() == 3
+    assert q.counts() == {}
+    rows = store.recent(3)
+    kinds = {r["task_id"]: r["answer_text"]["kind"] for r in rows}
+    assert kinds == {12: "binary", 1: "labels", 7: "ranking"}
 
 
 def test_worker_step_batch_poison_isolated(stack):

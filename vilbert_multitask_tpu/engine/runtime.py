@@ -701,19 +701,16 @@ class InferenceEngine:
         The BASELINE.md "full 12-task round-robin batch (shared trunk, all
         heads hot)" serving mode — every head computes over the whole batch
         anyway (the trunk dominates), and per-row ``task_ids`` keep the
-        task-token embeddings per-request, so any mix of single-image tasks
-        (VQA/GQA/SNLI-VE/grounding) packs into one MXU-efficient batch.
-        Multi-image tasks (NLVR2 pairs, retrieval) keep their replication
-        semantics through :meth:`run` — their rows are one *logical* request
-        and don't interleave.
+        task-token embeddings per-request, so any mix of tasks packs into
+        MXU-efficient batches. Multi-image requests (NLVR2 pairs,
+        retrieval) batch too: a request's rows stay consecutive inside a
+        chunk and every decode family reads its own row span (see
+        :meth:`decode`); requests are grouped by image count so NLVR2's
+        pair rows keep their even alignment (the binary head pairs batch
+        rows 2k/2k+1) and chunks stay densely packed.
         """
         if not reqs:
             return []
-        for r in reqs:
-            if r.n_images != 1:
-                raise ValueError(
-                    f"run_many packs single-image requests; task "
-                    f"{r.spec.task_id} has {r.n_images} images — use run()")
         # Oversized batches split into max-bucket chunks rather than erroring
         # (callers pick batch sizes; compiled buckets cap per-forward rows).
         # Bounded pipelining: up to _MAX_INFLIGHT_CHUNKS chunks dispatch
@@ -724,19 +721,31 @@ class InferenceEngine:
         # HBM at once.
         from collections import deque
 
-        # Chunk at the largest throughput bucket when configured: batched
-        # rows are independent single-image requests, so the 10-row
-        # retrieval cap on the image buckets doesn't apply — a 32-row chunk
-        # keeps the MXU fed instead of paying a dispatch round trip per 10
-        # rows (mid-size tails land on the intermediate buckets).
-        # ``chunk_rows`` overrides for callers tuning backlog shape (and
-        # the bench's 10-vs-32 comparison); it must fit a compiled bucket.
+        # Chunk at the largest throughput bucket when configured: the
+        # 10-row retrieval cap on the image buckets doesn't bound a packed
+        # chunk — a 32-row chunk keeps the MXU fed instead of paying a
+        # dispatch round trip per 10 rows (mid-size tails land on the
+        # intermediate buckets). ``chunk_rows`` overrides for callers
+        # tuning backlog shape (and the bench's 10-vs-32 comparison); it
+        # must fit a compiled bucket.
         max_bucket = (chunk_rows if chunk_rows is not None
                       else self.cfg.engine.max_batch_rows())
         self.cfg.engine.row_bucket_for(max_bucket)  # raises on <1 or misfit
-        chunks = [reqs[i : i + max_bucket]
-                  for i in range(0, len(reqs), max_bucket)]
-        out: List[dec.TaskResult] = []
+        # Group by image count (results keep input order via positions).
+        groups: Dict[int, List[Tuple[int, PreparedRequest]]] = {}
+        for pos, r in enumerate(reqs):
+            if r.n_images > max_bucket:
+                raise ValueError(
+                    f"request with {r.n_images} images exceeds the "
+                    f"{max_bucket}-row chunk; raise throughput_buckets or "
+                    f"chunk_rows")
+            groups.setdefault(r.n_images, []).append((pos, r))
+        chunks: List[List[Tuple[int, PreparedRequest]]] = []
+        for n, items in sorted(groups.items()):
+            cap = max_bucket // n  # >=1: n > max_bucket raised above
+            chunks.extend(items[i : i + cap]
+                          for i in range(0, len(items), cap))
+        out: List[Optional[dec.TaskResult]] = [None] * len(reqs)
         pending: deque = deque()
         dec_s = 0.0
         t0 = time.perf_counter()
@@ -746,12 +755,14 @@ class InferenceEngine:
             c, bundle = pending.popleft()
             bundle = jax.device_get(bundle)
             td = time.perf_counter()
-            out.extend(self.decode(r, bundle, row=i)
-                       for i, r in enumerate(c))
+            row = 0
+            for pos, r in c:
+                out[pos] = self.decode(r, bundle, row=row)
+                row += r.n_images
             dec_s += time.perf_counter() - td
 
         for c in chunks:
-            pending.append((c, self._dispatch_many(c)))
+            pending.append((c, self._dispatch_many([r for _, r in c])))
             if len(pending) >= self._MAX_INFLIGHT_CHUNKS:
                 _drain_one()
         while pending:
@@ -769,8 +780,11 @@ class InferenceEngine:
 
     def _dispatch_many(self, reqs: Sequence[PreparedRequest]):
         """Pack one ≤max-bucket chunk and dispatch its forward; returns the
-        un-fetched device decode bundle."""
-        n = len(reqs)
+        un-fetched device decode bundle. A request's rows (one per image,
+        text replicated — the multi-image contract of :meth:`prepare`) stay
+        consecutive, in request order."""
+        spans = [(r, i) for r in reqs for i in range(r.n_images)]
+        n = len(spans)
         bucket = self.cfg.engine.row_bucket_for(n)
         pad = bucket - n
 
@@ -779,23 +793,24 @@ class InferenceEngine:
             return np.stack(rows, axis=0)
 
         text = dict(
-            input_ids=pack([r.text.input_ids[0] for r in reqs],
-                           reqs[-1].text.input_ids[0]),
-            segment_ids=pack([r.text.segment_ids[0] for r in reqs],
-                             reqs[-1].text.segment_ids[0]),
-            input_mask=pack([r.text.input_mask[0] for r in reqs],
-                            reqs[-1].text.input_mask[0]),
-            task_ids=pack([r.task_ids[0] for r in reqs], reqs[-1].task_ids[0]),
+            input_ids=pack([r.text.input_ids[i] for r, i in spans],
+                           reqs[-1].text.input_ids[-1]),
+            segment_ids=pack([r.text.segment_ids[i] for r, i in spans],
+                             reqs[-1].text.segment_ids[-1]),
+            input_mask=pack([r.text.input_mask[i] for r, i in spans],
+                            reqs[-1].text.input_mask[-1]),
+            task_ids=pack([r.task_ids[i] for r, i in spans],
+                          reqs[-1].task_ids[-1]),
         )
         if self.mesh is not None:
             batch = dict(
                 text,
-                features=pack([r.features[0] for r in reqs],
-                              reqs[-1].features[0]),
-                spatials=pack([r.spatials[0] for r in reqs],
-                              reqs[-1].spatials[0]),
-                image_mask=pack([r.image_mask[0] for r in reqs],
-                                reqs[-1].image_mask[0]),
+                features=pack([r.features[i] for r, i in spans],
+                              reqs[-1].features[-1]),
+                spatials=pack([r.spatials[i] for r, i in spans],
+                              reqs[-1].spatials[-1]),
+                image_mask=pack([r.image_mask[i] for r, i in spans],
+                                reqs[-1].image_mask[-1]),
             )
             batch = jax.device_put(batch,
                                    shd.batch_shardings(batch, self.mesh))
@@ -805,7 +820,7 @@ class InferenceEngine:
             # here too — under queue backlog (the batched path) repeat images
             # cost no upload, same as solo serving. Pad slots use the shared
             # device pad row (zero upload; discarded at decode).
-            rows = [self._row_tensors(r, 0) for r in reqs]
+            rows = [self._row_tensors(r, i) for r, i in spans]
             if pad:
                 rows.extend([self._pad_row()] * pad)
             _, bundle = self._call_forward(

@@ -168,9 +168,11 @@ class ServeWorker:
 
     # ------------------------------------------------------- micro-batching
     def step_batch(self, max_jobs: Optional[int] = None) -> int:
-        """Drain up to ``max_jobs`` queued jobs and serve the packable
-        single-image ones in ONE forward (engine.run_many); multi-image jobs
-        claimed along the way run individually. Returns jobs completed.
+        """Drain up to ``max_jobs`` queued jobs and serve the packable ones
+        through batched forwards (engine.run_many, which groups by image
+        count so NLVR2 pairs and retrieval candidate sets batch too);
+        attention-map requests claimed along the way run individually
+        (per-request forward flag). Returns jobs completed.
 
         This is the TPU-shaped replacement for the reference's strictly
         serial batch=1 loop (worker.py:70,489,672-673): under queue backlog
@@ -181,19 +183,15 @@ class ServeWorker:
             # backlog the worker fills a whole throughput chunk (32 by
             # default) instead of capping at 8 and leaving the MXU starved.
             max_jobs = self.engine.cfg.engine.max_batch_rows()
-        singles: List[tuple] = []  # (job, qa_id, prepared, t0)
+        packable: List[tuple] = []  # (job, qa_id, prepared, t0)
         done = 0
         failed_ids: set = set()
-        while len(singles) < max_jobs:
+        while len(packable) < max_jobs:
             job = self.queue.claim(exclude=failed_ids)
             if job is None:
                 break
-            paths = job.body["image_path"]
-            if isinstance(paths, str):
-                paths = [paths]
-            if len(paths) != 1 or job.body.get("collect_attention"):
-                # multi-image semantics (pairs/retrieval) and attention-map
-                # requests (per-request forward flag): serve solo
+            if job.body.get("collect_attention"):
+                # attention maps are a per-request forward flag: serve solo
                 if self.step_one(job) == "acked":
                     done += 1
                 else:
@@ -201,19 +199,19 @@ class ServeWorker:
                 continue
             try:
                 qa_id, prepared, t0 = self._intake(job)
-                singles.append((job, qa_id, prepared, t0))
+                packable.append((job, qa_id, prepared, t0))
             except Exception:
                 self._fail_job(job)
                 failed_ids.add(job.id)
-        if not singles:
+        if not packable:
             return done
         try:
-            results = self.engine.run_many([p for _, _, p, _ in singles])
+            results = self.engine.run_many([p for _, _, p, _ in packable])
         except Exception:
-            for job, _, _, _ in singles:
+            for job, _, _, _ in packable:
                 self._fail_job(job)
             return done
-        for (job, qa_id, prepared, t0), result in zip(singles, results):
+        for (job, qa_id, prepared, t0), result in zip(packable, results):
             try:
                 self._finish_job(job, qa_id, prepared, result, t0)
                 self.queue.ack(job.id)

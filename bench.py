@@ -256,7 +256,54 @@ def _measure_throughput(engine, cfg, *, n: int = 160):
             "batch_speedup_vs_max_image_bucket": round(
                 qps_tb / max(qps_img, 1e-9), 3),
         })
+    out.update(_measure_throughput_mixed(engine, cfg))
     return out
+
+
+def _measure_throughput_mixed(engine, cfg, *, groups_n: int = 8):
+    """Literal "all heads hot" backlog: single-image tasks, NLVR2 pairs,
+    and retrieval-4 sets in one run_many call (multi-image batching landed
+    round 4 — this records that the 2-/10-image tasks stopped paying one
+    dispatch each). Reported as examples/s plus the padded-row TFLOP/s."""
+    from vilbert_multitask_tpu.engine.flops import serving_forward_flops
+
+    rng = np.random.default_rng(2)
+    regions = [synth_regions(rng, cfg) for _ in range(4)]
+    keys = [f"bench_mix_img_{i}" for i in range(4)]
+    pattern = [
+        (1, "what is the man holding", 1),
+        (12, "both images contain dogs", 2),
+        (15, "is the bowl right of the mug", 1),
+        (7, "a dog catching a frisbee", 4),
+        (13, "two dogs play in the snow", 1),
+        (12, "both images contain wolves", 2),
+    ]
+    reqs = []
+    for _ in range(groups_n):
+        for task_id, q, n in pattern:
+            reqs.append(engine.prepare(task_id, q, regions[:n],
+                                       cache_keys=keys[:n]))
+    engine.run_many(reqs[: len(pattern)])  # warm every group's bucket
+    t0 = time.perf_counter()
+    results = engine.run_many(reqs)
+    dt = time.perf_counter() - t0
+    assert len(results) == len(reqs)
+    # Mirror run_many's grouping for the padded-row FLOP accounting.
+    max_bucket = cfg.engine.max_batch_rows()
+    counts: dict = {}
+    for _, _, n in pattern:
+        counts[n] = counts.get(n, 0) + groups_n
+    rows = 0
+    for n, k in counts.items():
+        cap = max_bucket // n
+        full, tail = divmod(k, cap)
+        rows += full * cfg.engine.row_bucket_for(cap * n)
+        if tail:
+            rows += cfg.engine.row_bucket_for(tail * n)
+    tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
+    return {"batch_qps_mixed": round(len(reqs) / dt, 2),
+            "batch_tflops_mixed": round(tflops, 4),
+            "batch_mixed_n": len(reqs)}
 
 
 def run_measurement() -> None:

@@ -98,10 +98,13 @@ def main(argv=None) -> int:
                    help="max |score - expected| accepted (scores are 0-1 "
                         "fractions; 0.01 = one point)")
     p.add_argument("--batch", type=int, default=8)
-    p.add_argument("--tiny", action="store_true",
-                   help="tiny model config (rehearsal/tests, not deployment)")
-    p.add_argument("--cpu", action="store_true",
-                   help="pin the CPU backend (f32, XLA attention)")
+    from vilbert_multitask_tpu.config import (
+        FrameworkConfig,
+        add_backend_args,
+        apply_backend_args,
+    )
+
+    add_backend_args(p)
     args = p.parse_args(argv)
 
     # Validate the request shape before any expensive work.
@@ -114,25 +117,13 @@ def main(argv=None) -> int:
 
     import dataclasses
 
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
     from vilbert_multitask_tpu.checkpoint import save_params
     from vilbert_multitask_tpu.checkpoint.convert import load_torch_checkpoint
-    from vilbert_multitask_tpu.config import FrameworkConfig
     from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 
-    cfg = FrameworkConfig()
-    if args.tiny:
-        cfg = dataclasses.replace(cfg, model=cfg.model.tiny())
-    over = dict(vocab_path=args.vocab, labels_root=args.labels)
-    if args.cpu:
-        over.update(compute_dtype="float32", use_pallas_coattention=False,
-                    use_pallas_self_attention=False)
-    cfg = dataclasses.replace(
-        cfg, engine=dataclasses.replace(cfg.engine, **over))
+    cfg = apply_backend_args(FrameworkConfig(), args)
+    cfg = dataclasses.replace(cfg, engine=dataclasses.replace(
+        cfg.engine, vocab_path=args.vocab, labels_root=args.labels))
 
     report: Dict = {"torch_bin": args.torch_bin, "steps": {}}
 

@@ -393,3 +393,30 @@ class FrameworkConfig:
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+
+
+def add_backend_args(parser) -> None:
+    """The shared --tiny/--cpu CLI knobs (evals harness, onboarding CLI):
+    one definition so a new backend knob can't silently diverge between
+    entry points."""
+    parser.add_argument("--tiny", action="store_true",
+                        help="tiny model config (rehearsal/tests; must "
+                             "match any checkpoint being loaded)")
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin the CPU backend (f32, XLA attention)")
+
+
+def apply_backend_args(cfg: FrameworkConfig, args) -> FrameworkConfig:
+    """Apply add_backend_args selections. With --cpu this must run before
+    any jax backend init: it pins jax_platforms in-process (this image's
+    sitecustomize registers a remote TPU plugin that otherwise wins)."""
+    if getattr(args, "cpu", False):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        cfg = dataclasses.replace(cfg, engine=dataclasses.replace(
+            cfg.engine, compute_dtype="float32",
+            use_pallas_coattention=False, use_pallas_self_attention=False))
+    if getattr(args, "tiny", False):
+        cfg = dataclasses.replace(cfg, model=cfg.model.tiny())
+    return cfg

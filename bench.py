@@ -32,6 +32,9 @@ Env knobs:
 - ``BENCH_PROBE=0`` skip the pre-attempt backend probe (default ON for the
   hardware path; TINY mode never probes). ``BENCH_PROBE_TIMEOUT_S`` (240),
   ``BENCH_PROBE_BACKOFF_S`` (45) tune the probe cycle.
+- ``BENCH_PROFILE_DIR`` capture a ``jax.profiler`` device trace of one
+  warm round-robin pass into this directory (inspect with TensorBoard /
+  xprof) — the diagnosis artifact for any surprising hardware number.
 - ``BENCH_WALL_BUDGET_S`` (3300) total wall budget for the orchestrator:
   attempts are sized to fit what remains, and no attempt starts that cannot
   finish inside it — a dead tunnel burns cheap probes, not 1800 s children.
@@ -161,6 +164,16 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
     for req in reqs:
         engine.run(req)
     per_pass_s = time.perf_counter() - t0
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        # One traced warm pass, separate from the timed epochs (tracing
+        # adds overhead; the headline numbers must not carry it).
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            for req in reqs:
+                engine.run(req)
+        print(f"# profiler trace written to {profile_dir}", file=sys.stderr)
     # Scale timed work to the budget so the bench fits on any backend
     # (CPU smoke runs are ~100x slower than the TPU path). The cap exists
     # for fast backends; 30 epochs × 11 queries gives percentiles real
@@ -484,8 +497,11 @@ def _maybe_compare(headline: dict, timeout_s: float | None = None) -> dict:
             and headline["value"] < COMPARE_MAX_P50_MS):
         return headline
     print("# compare child: XLA-attention engine...", file=sys.stderr)
+    # BENCH_PROFILE_DIR cleared: the compare child would otherwise write an
+    # indistinguishable pallas-off trace into the same diagnosis directory.
     line, err = _run_child(min(COMPARE_TIMEOUT_S, timeout_s or COMPARE_TIMEOUT_S),
-                           {"BENCH_PALLAS": "0", "BENCH_COMPARE": "0"})
+                           {"BENCH_PALLAS": "0", "BENCH_COMPARE": "0",
+                            "BENCH_PROFILE_DIR": ""})
     if line is None:
         print(f"# compare child failed ({err}); headline unchanged",
               file=sys.stderr)

@@ -243,6 +243,41 @@ def test_mesh_sharded_engine_matches_single_device(tiny_config):
     ]
 
 
+def test_mesh_sharded_run_many_matches_single_device(tiny_config):
+    """The batched path's mesh branch (_dispatch_many packs a whole-chunk
+    device_put with batch shardings) must reproduce single-device decodes
+    for a mixed single/multi-image backlog."""
+    cfg = FrameworkConfig(
+        model=tiny_config,
+        engine=_cpu_engine_cfg(max_regions=11, image_buckets=(1, 2, 4),
+                               throughput_buckets=(8,)),
+        mesh=MeshConfig(dp=4, tp=2),
+    )
+    base = InferenceEngine(cfg, seed=3)
+    sharded = InferenceEngine(cfg, seed=3, mesh=build_mesh(cfg.mesh))
+
+    regions = make_regions(4, feat_dim=cfg.model.v_feature_size, seed=5)
+    backlog = [
+        (1, "what is the man holding", 1),
+        (12, "both images contain wolves", 2),
+        (7, "a red car parked outside", 4),
+        (15, "is the bowl right of the mug", 1),
+        (12, "both show dogs", 2),
+    ]
+    res_a = base.run_many([base.prepare(t, q, regions[:n])
+                           for t, q, n in backlog])
+    res_b = sharded.run_many([sharded.prepare(t, q, regions[:n])
+                              for t, q, n in backlog])
+    assert [r.kind for r in res_a] == [r.kind for r in res_b]
+    for a, b in zip(res_a, res_b):
+        if a.answers is not None:
+            assert [x["answer"] for x in a.answers] == \
+                [x["answer"] for x in b.answers]
+        if a.ranking is not None:
+            assert [x["image"] for x in a.ranking] == \
+                [x["image"] for x in b.ranking]
+
+
 def test_partition_rules_shard_big_matmuls(tiny_config):
     """TP rules must actually shard the FFN/QKV kernels when dims divide."""
     cfg = FrameworkConfig(

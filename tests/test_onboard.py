@@ -72,9 +72,35 @@ def test_onboard_end_to_end(tmp_path, capsys):
     with open(expect_path, "w") as f:
         json.dump({"vqa": {"accuracy": vqa_res["accuracy"]}}, f)
 
+    # Detector stand-in: a torch-serialized Faster R-CNN checkpoint in the
+    # detectron {"model": {...}} envelope, built with the same fixture
+    # helper the converter tests use (nontrivial BN running stats included).
+    from vilbert_multitask_tpu.config import DetectorConfig
+    from vilbert_multitask_tpu.detect.model import FasterRCNN
+    from tests.test_detect_convert import _synthetic_torch_sd
+
+    import jax
+
+    # Onboarding derives representation_size from the trunk's
+    # v_feature_size (like serve/app.py), so the stand-in must match it.
+    import dataclasses as dc
+
+    dcfg = dc.replace(DetectorConfig().tiny(),
+                      representation_size=cfg.model.v_feature_size)
+    det_model = FasterRCNN(dcfg)
+    c = dcfg.canvas
+    det_params = det_model.init(jax.random.PRNGKey(0),
+                                np.zeros((c, c, 3), np.float32),
+                                np.asarray([c, c], np.float32))["params"]
+    det_bin = str(tmp_path / "detectron_model.pth")
+    torch.save({"model": {k: torch.from_numpy(np.array(v))
+                          for k, v in _synthetic_torch_sd(
+                              dcfg, det_params).items()}}, det_bin)
+
     out_dir = str(tmp_path / "onboarded")
     argv = ["--torch-bin", bin_path, "--vocab", vocab,
             "--labels", labels_root, "--out", out_dir,
+            "--detector-bin", det_bin,
             "--eval", f"vqa={os.path.join(GOLDEN, 'vqa.jsonl')}",
             "--features", os.path.join(GOLDEN, "features"),
             "--expect", expect_path, "--tol", "1e-9",
@@ -86,6 +112,8 @@ def test_onboard_end_to_end(tmp_path, capsys):
     assert report["steps"]["convert"]["ok"]
     assert report["steps"]["boot"]["vocab_tokens"] > 1000
     assert report["steps"]["parity"]["failures"] == []
+    assert report["steps"]["detector"]["n_boxes"] >= 1
+    assert os.path.isdir(report["steps"]["detector"]["params_dir"])
     # Smoke answers decoded from the PROVIDED label files, not synthetics.
     assert report["steps"]["smoke"]["tasks"]["1"]["top"].startswith("ans_")
     # Converted params persisted through the production Orbax path.

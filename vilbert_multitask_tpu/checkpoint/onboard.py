@@ -87,6 +87,10 @@ def main(argv=None) -> int:
                    help="answer-vocabulary dir (JSON/pickle label maps)")
     p.add_argument("--out", required=True,
                    help="output dir: converted Orbax params + report.json")
+    p.add_argument("--detector-bin", default=None,
+                   help="optional Faster R-CNN torch checkpoint (the "
+                        "reference's X-152 detectron weights, worker.py:82-85)"
+                        " — converted for --live-extract serving")
     p.add_argument("--eval", action="append", default=[],
                    metavar="TASK=DATA.jsonl",
                    help="run the eval harness on this task/data (repeatable)")
@@ -136,6 +140,48 @@ def main(argv=None) -> int:
         "ok": True, "params_dir": params_dir,
         "wall_s": round(time.perf_counter() - t0, 1)}
     _log(f"convert ok → {params_dir}")
+
+    # 1b. detector (optional) ----------------------------------------------
+    if args.detector_bin:
+        from vilbert_multitask_tpu.config import DetectorConfig
+        from vilbert_multitask_tpu.detect.convert import load_torch_detector
+        from vilbert_multitask_tpu.detect.extractor import LiveFeatureExtractor
+
+        t0 = time.perf_counter()
+        dcfg = DetectorConfig().tiny() if args.tiny else DetectorConfig()
+        # Same derivation serving uses (serve/app.py): the detector's fc6
+        # width IS the trunk's region-feature width — a mismatch here would
+        # pass onboarding and crash at the first live-extraction request.
+        dcfg = dataclasses.replace(
+            dcfg, representation_size=cfg.model.v_feature_size)
+        det_params = load_torch_detector(args.detector_bin, dcfg)
+        det_dir = os.path.abspath(os.path.join(args.out, "detector_params"))
+        save_params(det_dir, det_params, force=True)
+        # Smoke the live path the converted weights will serve
+        # (serve.app --live-extract): one synthetic image through the full
+        # extractor, boxes out.
+        import numpy as np
+
+        ex = LiveFeatureExtractor(dcfg, params=det_params)
+        img = (np.random.default_rng(0).random((300, 400, 3)) * 255
+               ).astype(np.uint8)
+        regions = ex.extract_array(img)
+        # extract_array clamps to >=1 box, so n_boxes alone can't flag a
+        # degenerate conversion — non-finite features and a feature-width
+        # mismatch with the trunk are the real smoke signals.
+        if not np.all(np.isfinite(regions.features)):
+            raise SystemExit("detector smoke produced non-finite features "
+                             "— converted weights are broken")
+        if regions.features.shape[1] != cfg.model.v_feature_size:
+            raise SystemExit(
+                f"detector feature width {regions.features.shape[1]} != "
+                f"trunk v_feature_size {cfg.model.v_feature_size}")
+        report["steps"]["detector"] = {
+            "ok": True, "params_dir": det_dir,
+            "n_boxes": int(regions.features.shape[0]),
+            "wall_s": round(time.perf_counter() - t0, 1)}
+        _log(f"detector convert+smoke ok → {det_dir} "
+             f"({regions.features.shape[0]} boxes)")
 
     # 2. boot ---------------------------------------------------------------
     t0 = time.perf_counter()

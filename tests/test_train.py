@@ -120,6 +120,33 @@ def test_sharded_train_step_on_mesh():
     assert ffn2.sharding == ffn_kernel.sharding
 
 
+def test_place_batch_callback_path_matches_device_put():
+    """The multi-process placement path (make_array_from_callback slicing a
+    host-global batch) must produce arrays identical in value, sharding,
+    and train-step result to the single-process device_put path — it's the
+    same global batch either way, only shard construction differs."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg, model, params, batch = _setup(tp_divisible=True)
+    mesh = build_mesh(MeshConfig(tp=2), devices=jax.devices()[:8])
+    with mesh:
+        a = shd.place_batch(batch, mesh)
+        b = shd.place_batch(batch, mesh, _force_callback=True)
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(a),
+                              jax.tree_util.tree_leaves(b)):
+        assert leaf_a.sharding == leaf_b.sharding
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+    tx = default_optimizer(warmup_steps=1, total_steps=10)
+    loss_cfg = LossConfig(heads=("vqa", "tri"))
+    state = shard_train_state(create_train_state(params, tx), mesh)
+    with mesh:
+        step = make_train_step(model, tx, loss_cfg, donate=False)
+        _, m_a = step(state, a)
+        _, m_b = step(state, b)
+    assert float(m_a["loss/total"]) == float(m_b["loss/total"])
+
+
 def test_remat_matches_plain_gradients():
     """cfg.remat changes memory/FLOPs, never values: same loss, same grads."""
     import dataclasses

@@ -447,8 +447,7 @@ class InferenceEngine:
             if self.mesh is not None:
                 # Match run()'s input shardings exactly — a different input
                 # sharding is a different XLA program (fresh compile).
-                batch = jax.device_put(batch,
-                                       shd.batch_shardings(batch, self.mesh))
+                batch = shd.place_batch(batch, self.mesh)
                 _, bundle = self._call_forward(b, False, batch)
             else:
                 # Warm the per-row program run()/run_many() actually use.
@@ -674,8 +673,7 @@ class InferenceEngine:
             # a single-device optimization).
             batch = {**text, "features": req.features,
                      "spatials": req.spatials, "image_mask": req.image_mask}
-            batch = jax.device_put(batch,
-                                   shd.batch_shardings(batch, self.mesh))
+            batch = shd.place_batch(batch, self.mesh)
             out, bundle = self._call_forward(req.bucket, collect_attention,
                                              batch)
         else:
@@ -812,8 +810,7 @@ class InferenceEngine:
                 image_mask=pack([r.image_mask[i] for r, i in spans],
                                 reqs[-1].image_mask[-1]),
             )
-            batch = jax.device_put(batch,
-                                   shd.batch_shardings(batch, self.mesh))
+            batch = shd.place_batch(batch, self.mesh)
             _, bundle = self._call_forward(bucket, False, batch)
         else:
             # Per-row image tensors: store-backed rows ride the device cache

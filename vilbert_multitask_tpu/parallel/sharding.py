@@ -105,3 +105,42 @@ def batch_shardings(batch: Any, mesh: Mesh) -> Any:
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map(one, batch)
+
+
+def place_batch(batch: Any, mesh: Mesh, *, global_batch: bool = False,
+                _force_callback: bool = False) -> Any:
+    """Place a batch pytree onto the mesh, dp-sharded.
+
+    When every mesh device is addressable (the virtual CPU mesh, a single
+    TPU slice host): one ``device_put``. On a mesh spanning processes,
+    ``device_put`` cannot target non-addressable devices; the ONLY
+    supported cross-process placement is ``global_batch=True`` — the
+    caller guarantees every process holds the IDENTICAL global batch, and
+    each contributes its addressable shards via
+    ``jax.make_array_from_callback``. The trainer qualifies (loaders draw
+    statelessly from the GLOBAL step — the bit-exact-resume design,
+    train/loop.py: same batch on every host, DCN carries no tensors).
+    Serving does NOT (each host builds batches from its own requests), so
+    its calls leave the default and fail loudly here instead of silently
+    stitching a global array out of mismatched per-host rows.
+    """
+    import numpy as np
+
+    shardings = batch_shardings(batch, mesh)
+    local_mesh = all(d.process_index == jax.process_index()
+                     for d in mesh.devices.flat)
+    if (jax.process_count() == 1 or local_mesh) and not _force_callback:
+        return jax.device_put(batch, shardings)
+    if not global_batch and not _force_callback:
+        raise NotImplementedError(
+            "batch placement on a mesh spanning processes needs "
+            "global_batch=True (identical batch on every process) — "
+            "per-host serving batches cannot shard onto a cross-process "
+            "mesh; route requests per host instead")
+
+    def one(leaf, sh):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+
+    return jax.tree_util.tree_map(one, batch, shardings)

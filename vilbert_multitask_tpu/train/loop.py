@@ -645,7 +645,9 @@ class Trainer:
             return batch
         from vilbert_multitask_tpu.parallel import sharding as shd
 
-        return jax.device_put(batch, shd.batch_shardings(batch, self.mesh))
+        # global_batch: the samplers draw from the GLOBAL step, so every
+        # process holds this identical batch (the cross-process contract).
+        return shd.place_batch(batch, self.mesh, global_batch=True)
 
     def _save(self, step: int) -> None:
         from vilbert_multitask_tpu.checkpoint.store import save_train_state

@@ -457,6 +457,31 @@ def test_frontend_served_to_browsers(stack):
         api.stop()
 
 
+def test_admin_console_served_to_browsers(stack):
+    """GET /admin with a browser Accept header returns the admin console
+    page (the reference's Django admin UI surface); API clients get an
+    endpoint index."""
+    s, hub, q, store, worker = stack
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/admin", headers={"Accept": "text/html,*/*"})
+        resp = conn.getresponse()
+        html = resp.read().decode()
+        assert resp.status == 200
+        for needle in ("/admin/tasks", "/admin/questionanswer", "taskRow",
+                       "num_of_images_min"):
+            assert needle in html, needle
+
+        conn.request("GET", "/admin",
+                     headers={"Accept": "application/json"})
+        idx = json.loads(conn.getresponse().read())
+        assert "POST /admin/tasks/<id>" in idx["endpoints"]
+    finally:
+        api.stop()
+
+
 def test_healthz_reports_boot_info(stack):
     """VERDICT r2 #3: init/warmup timings + kernel path must be observable
     at /healthz, fed live by ServeApp.warm()."""

@@ -151,8 +151,8 @@ class ApiServer:
                     # Browsers get the single-page demo app (the reference's
                     # index.html render, views.py:39-42); API clients keep
                     # the JSON contract.
-                    if "text/html" in self.headers.get("Accept", ""):
-                        self._serve_index()
+                    if self._wants_html():
+                        self._serve_static_page("index.html")
                         return
                     self._json(200, {
                         "tasks": api.store.list_tasks(),
@@ -178,6 +178,17 @@ class ApiServer:
                     self._json(*api.demo_images())
                 elif self.path.startswith("/media/"):
                     self._serve_media()
+                elif path == "/admin":
+                    # The admin console page (reference: the Django admin
+                    # UI, demo/admin.py) — browsers get HTML, API clients
+                    # an index of the admin endpoints.
+                    if self._wants_html():
+                        self._serve_static_page("admin.html")
+                        return
+                    self._json(200, {"endpoints": [
+                        "/admin/tasks", "/admin/questionanswer",
+                        "POST /admin/tasks/<id>",
+                        "POST /admin/questionanswer/<id>"]})
                 elif path == "/admin/tasks":
                     # Browse surface over the task catalog
                     # (reference demo/admin.py:7-21 TaskAdmin list view).
@@ -217,9 +228,13 @@ class ApiServer:
                 else:
                     self._json(404, {"error": "not found"})
 
-            def _serve_index(self):
+            def _wants_html(self) -> bool:
+                """Browser-vs-API content negotiation (one place)."""
+                return "text/html" in self.headers.get("Accept", "")
+
+            def _serve_static_page(self, name: str):
                 page = os.path.join(os.path.dirname(__file__), "static",
-                                    "index.html")
+                                    name)
                 try:
                     with open(page, "rb") as f:
                         body = f.read()

@@ -1,0 +1,77 @@
+"""Ring attention (parallel/ring.py): sequence-parallel EXACT attention.
+
+The contract is exactness, not approximation: rotating KV blocks around
+the mesh ring with an online-softmax accumulator must reproduce dense
+softmax attention to float tolerance, masks included, for any sp that
+divides the sequence. Validated on the virtual 8-device CPU mesh
+(conftest pins the platform and forces 8 devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from vilbert_multitask_tpu.ops.attention import (
+    mask_to_bias,
+    multi_head_attention,
+)
+from vilbert_multitask_tpu.parallel.ring import make_ring_attention
+
+
+def _qkv(b=2, nq=16, nk=16, h=4, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda *s: jnp.asarray(rng.normal(size=s).astype(np.float32))
+    return mk(b, nq, h, d), mk(b, nk, h, d), mk(b, nk, h, d)
+
+
+def _sp_mesh(sp: int):
+    if len(jax.devices()) < sp:
+        pytest.skip(f"needs {sp} virtual devices")
+    return Mesh(np.asarray(jax.devices()[:sp]).reshape(sp), ("sp",))
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense(sp):
+    mesh = _sp_mesh(sp)
+    q, k, v = _qkv()
+    ring = make_ring_attention(mesh)
+    got = np.asarray(ring(q, k, v))
+    want, _ = multi_head_attention(q, k, v, None, dtype=jnp.float32)
+    np.testing.assert_allclose(got, np.asarray(want), atol=2e-5)
+
+
+def test_ring_respects_kv_mask():
+    mesh = _sp_mesh(4)
+    q, k, v = _qkv(nq=8, nk=32, seed=3)
+    rng = np.random.default_rng(4)
+    mask = jnp.asarray((rng.random((2, 32)) > 0.4).astype(np.int32))
+    # ensure at least one valid key per row (all-masked rows are undefined
+    # for both paths)
+    mask = mask.at[:, 0].set(1)
+    ring = make_ring_attention(mesh)
+    got = np.asarray(ring(q, k, v, mask))
+    want, _ = multi_head_attention(q, k, v, mask_to_bias(mask),
+                                   dtype=jnp.float32)
+    np.testing.assert_allclose(got, np.asarray(want), atol=2e-5)
+
+
+def test_ring_region_scale_shapes():
+    """The long-context case this exists for: a region sequence far past
+    the serving bucket (e.g. tiled detections), sharded 8 ways — per-device
+    KV is N/8 and the output is still exact."""
+    mesh = _sp_mesh(8)
+    q, k, v = _qkv(b=1, nq=64, nk=512, h=2, d=16, seed=7)
+    ring = make_ring_attention(mesh)
+    got = np.asarray(ring(q, k, v))
+    want, _ = multi_head_attention(q, k, v, None, dtype=jnp.float32)
+    np.testing.assert_allclose(got, np.asarray(want), atol=2e-5)
+    assert got.shape == (1, 64, 2, 16)
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = _sp_mesh(8)
+    q, k, v = _qkv(nq=12, nk=12)  # 12 % 8 != 0
+    ring = make_ring_attention(mesh)
+    with pytest.raises(Exception):
+        ring(q, k, v)

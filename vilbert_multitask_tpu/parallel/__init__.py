@@ -5,11 +5,16 @@ see SURVEY.md §2.3.
 """
 
 from vilbert_multitask_tpu.parallel.mesh import build_mesh, local_mesh_info
+from vilbert_multitask_tpu.parallel.ring import (
+    make_ring_attention,
+    ring_attention_shard,
+)
 from vilbert_multitask_tpu.parallel.sharding import (
     batch_shardings,
     batch_spec,
     param_shardings,
     param_specs,
+    place_batch,
     shard_params,
 )
 
@@ -18,7 +23,10 @@ __all__ = [
     "local_mesh_info",
     "batch_shardings",
     "batch_spec",
+    "make_ring_attention",
     "param_shardings",
     "param_specs",
+    "place_batch",
+    "ring_attention_shard",
     "shard_params",
 ]

@@ -49,7 +49,6 @@ from vilbert_multitask_tpu.engine import decode as dec
 from vilbert_multitask_tpu.engine.labels import LabelMapStore
 from vilbert_multitask_tpu.features.pipeline import (
     GLOBAL_BOX,
-    EncodedImage,
     RegionFeatures,
     batch_images,
     clip_regions,

@@ -9,7 +9,7 @@ package driven from worker.py:286-289 — re-designed as Flax modules.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn

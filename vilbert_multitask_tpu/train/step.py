@@ -10,7 +10,7 @@ ride ICI. State buffers are donated so the update is in-place in HBM.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -69,9 +69,32 @@ def test_ring_region_scale_shapes():
     assert got.shape == (1, 64, 2, 16)
 
 
+def test_ring_composes_with_data_parallel():
+    """dp×sp mesh: batch shards over dp, sequence over sp — each dp group
+    runs its own independent ring, still exact vs dense."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    q, k, v = _qkv(b=4, nq=16, nk=64, seed=9)
+    ring = make_ring_attention(mesh, batch_axis="dp")
+    got = np.asarray(ring(q, k, v))
+    want, _ = multi_head_attention(q, k, v, None, dtype=jnp.float32)
+    np.testing.assert_allclose(got, np.asarray(want), atol=2e-5)
+
+
 def test_ring_rejects_indivisible_seq():
     mesh = _sp_mesh(8)
     q, k, v = _qkv(nq=12, nk=12)  # 12 % 8 != 0
     ring = make_ring_attention(mesh)
+    with pytest.raises(Exception):
+        ring(q, k, v)
+
+
+def test_ring_rejects_indivisible_batch():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    q, k, v = _qkv(b=3, nq=16, nk=16)  # 3 % dp=2 != 0
+    ring = make_ring_attention(mesh, batch_axis="dp")
     with pytest.raises(Exception):
         ring(q, k, v)

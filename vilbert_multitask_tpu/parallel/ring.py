@@ -102,6 +102,7 @@ def ring_attention_shard(q, k, v, kv_bias, *, axis_name: str,
 
 
 def make_ring_attention(mesh: Mesh, *, sp_axis: str = "sp",
+                        batch_axis: Optional[str] = None,
                         dtype=jnp.float32):
     """Jitted global-array ring attention over ``mesh``'s ``sp_axis``.
 
@@ -109,16 +110,21 @@ def make_ring_attention(mesh: Mesh, *, sp_axis: str = "sp",
     (or None → all-valid), returns global context (B, Nq, H, D) — exact,
     bit-for-intent equal to dense softmax attention. The ``sp_axis`` size
     must divide Nq and Nk (static-shape contract, like the image buckets).
+    With ``batch_axis`` the batch dim shards too (dp×sp composition: each
+    dp row group runs its own independent KV ring — rings never cross dp);
+    the ``batch_axis`` size must then divide B, same contract shape.
     """
     from vilbert_multitask_tpu.ops.attention import mask_to_bias
 
+    b_ax = batch_axis
+    specs = (P(b_ax, sp_axis), P(b_ax, sp_axis), P(b_ax, sp_axis),
+             P(b_ax, None, None, sp_axis))
     shard = functools.partial(ring_attention_shard, axis_name=sp_axis,
                               dtype=dtype)
     mapped = jax.shard_map(
         shard, mesh=mesh,
-        in_specs=(P(None, sp_axis), P(None, sp_axis), P(None, sp_axis),
-                  P(None, None, None, sp_axis)),
-        out_specs=P(None, sp_axis),
+        in_specs=specs,
+        out_specs=P(b_ax, sp_axis),
         check_vma=False,
     )
 
@@ -127,12 +133,9 @@ def make_ring_attention(mesh: Mesh, *, sp_axis: str = "sp",
         if mask is None:
             mask = jnp.ones(k.shape[:2], jnp.int32)
         bias = mask_to_bias(mask, dtype)  # (B, 1, 1, Nk)
-        args = (q, k, v, bias)
         placed = [
             jax.device_put(a, NamedSharding(mesh, spec))
-            for a, spec in zip(args, (
-                P(None, sp_axis), P(None, sp_axis), P(None, sp_axis),
-                P(None, None, None, sp_axis)))
+            for a, spec in zip((q, k, v, bias), specs)
         ]
         return mapped(*placed)
 

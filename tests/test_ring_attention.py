@@ -76,9 +76,16 @@ def test_ring_composes_with_data_parallel():
         pytest.skip("needs 8 virtual devices")
     mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
     q, k, v = _qkv(b=4, nq=16, nk=64, seed=9)
+    # Masked on purpose: the bias carries per-ROW mask state, so a
+    # mis-sharded bias spec (batch dim not split over dp → groups reading
+    # each other's mask rows) would only show up with a non-uniform mask.
+    rng = np.random.default_rng(10)
+    mask = jnp.asarray((rng.random((4, 64)) > 0.4).astype(np.int32))
+    mask = mask.at[:, 0].set(1)
     ring = make_ring_attention(mesh, batch_axis="dp")
-    got = np.asarray(ring(q, k, v))
-    want, _ = multi_head_attention(q, k, v, None, dtype=jnp.float32)
+    got = np.asarray(ring(q, k, v, mask))
+    want, _ = multi_head_attention(q, k, v, mask_to_bias(mask),
+                                   dtype=jnp.float32)
     np.testing.assert_allclose(got, np.asarray(want), atol=2e-5)
 
 

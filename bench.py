@@ -76,18 +76,10 @@ FORCE_PALLAS = os.environ.get("BENCH_PALLAS", "")
 
 
 def synth_regions(rng, cfg, n_boxes=100):
-    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.pipeline import synthetic_regions
 
-    w, h = 640, 480
-    x1 = rng.random((n_boxes,)) * (w - 32)
-    y1 = rng.random((n_boxes,)) * (h - 32)
-    boxes = np.stack(
-        [x1, y1, x1 + 16 + rng.random(n_boxes) * (w / 4),
-         y1 + 16 + rng.random(n_boxes) * (h / 4)], axis=1
-    ).astype(np.float32)
-    feats = rng.normal(size=(n_boxes, cfg.model.v_feature_size)).astype(
-        np.float32)
-    return RegionFeatures(feats, boxes, w, h)
+    return synthetic_regions(cfg.model.v_feature_size, n_boxes=n_boxes,
+                             rng=rng)
 
 
 # The 8 served task types (config.TASK_REGISTRY). Retrieval runs at 2, 4, 8

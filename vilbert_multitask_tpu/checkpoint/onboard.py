@@ -49,23 +49,6 @@ def _log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def _synth_regions(cfg, n_boxes: int = 36, seed: int = 0):
-    import numpy as np
-
-    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
-
-    rng = np.random.default_rng(seed)
-    w, h = 640, 480
-    x1 = rng.random((n_boxes,)) * (w - 32)
-    y1 = rng.random((n_boxes,)) * (h - 32)
-    boxes = np.stack(
-        [x1, y1, x1 + 16 + rng.random(n_boxes) * (w / 4),
-         y1 + 16 + rng.random(n_boxes) * (h / 4)], axis=1).astype(np.float32)
-    feats = rng.normal(size=(n_boxes, cfg.model.v_feature_size)).astype(
-        np.float32)
-    return RegionFeatures(feats, boxes, w, h)
-
-
 def _parse_evals(items: List[str]) -> Dict[str, str]:
     out: Dict[str, str] = {}
     for it in items:
@@ -195,7 +178,9 @@ def main(argv=None) -> int:
          f"{cfg.model.vocab_size} rows")
 
     # 3. smoke --------------------------------------------------------------
-    regions = [_synth_regions(cfg)]
+    from vilbert_multitask_tpu.features.pipeline import synthetic_regions
+
+    regions = [synthetic_regions(cfg.model.v_feature_size, n_boxes=36)]
     smoke = {}
     for task_id, q in ((1, "what is the man holding"),
                        (15, "is the bowl right of the mug"),

@@ -137,3 +137,23 @@ def batch_images(
         masks[i, 0] = 1
         spatials[i, 0] = GLOBAL_BOX
     return feats, spatials, masks
+
+
+def synthetic_regions(v_feature_size: int, *, n_boxes: int = 100,
+                      rng=None, seed: int = 0,
+                      image_w: int = 640, image_h: int = 480
+                      ) -> RegionFeatures:
+    """Plausibly-shaped random regions (x2>x1/y2>y1 boxes anchored inside
+    the canvas — they may overhang the right/bottom edge, like loose
+    detector output — N(0,1) features) for benches, smokes, and demos:
+    the shared synthetic-input generator (bench round-robin, onboarding
+    smoke). Not a source of normalized-spatial guarantees."""
+    rng = rng or np.random.default_rng(seed)
+    x1 = rng.random((n_boxes,)) * (image_w - 32)
+    y1 = rng.random((n_boxes,)) * (image_h - 32)
+    boxes = np.stack(
+        [x1, y1, x1 + 16 + rng.random(n_boxes) * (image_w / 4),
+         y1 + 16 + rng.random(n_boxes) * (image_h / 4)],
+        axis=1).astype(np.float32)
+    feats = rng.normal(size=(n_boxes, v_feature_size)).astype(np.float32)
+    return RegionFeatures(feats, boxes, image_w, image_h)

@@ -568,3 +568,30 @@ def test_trainer_never_snapshots_diverged_state(train_cfg, tmp_path):
     snaps = ([n for n in os.listdir(out) if n.startswith("step_")]
              if os.path.isdir(out) else [])
     assert not snaps
+
+
+def test_indexed_jsonl_matches_eager_load(tmp_path):
+    """IndexedJsonl is a drop-in for the eager loader: same records, same
+    order, random access by offset, blank lines skipped, memory held is
+    offsets not records."""
+    from vilbert_multitask_tpu.evals.harness import load_jsonl
+    from vilbert_multitask_tpu.utils import IndexedJsonl
+
+    p = tmp_path / "data.jsonl"
+    rows = [{"i": i, "text": f"q{i}" * (i % 5 + 1)} for i in range(57)]
+    with open(p, "w") as f:
+        for i, r in enumerate(rows):
+            f.write(json.dumps(r) + "\n")
+            if i % 7 == 0:
+                f.write("\n")  # blank lines must not shift indices
+    eager = load_jsonl(str(p))
+    lazy = IndexedJsonl(str(p))
+    assert len(lazy) == len(eager) == 57
+    assert list(lazy) == eager
+    assert lazy[13] == eager[13]
+    assert lazy[-1] == eager[-1]  # negative indexing
+    with pytest.raises(IndexError):
+        lazy[57]
+    # numpy integer indices (what the sampler draws) work
+    assert lazy[np.int64(3)] == eager[3]
+    lazy.close()

@@ -273,14 +273,18 @@ class JsonlTaskData:
     def __init__(self, head: str, jsonl_path: str, feature_store, tokenizer,
                  cfg: FrameworkConfig, *, label_map=None, seed: int = 0,
                  group_size: int = 2):
-        from vilbert_multitask_tpu.evals.harness import load_jsonl
+        from vilbert_multitask_tpu.utils import IndexedJsonl
 
         if head not in ("vqa", "gqa", "tri", "binary", "grounding",
                         "pretrain", "retrieval"):
             raise ValueError(f"no JSONL loader for head {head!r}")
         self.group_size = group_size
         self.head = head
-        self.examples = load_jsonl(jsonl_path)
+        # Offset-indexed, not loaded whole: the sampler draws random
+        # indices per step, and at real 12-in-1 dataset sizes (hundreds of
+        # thousands to millions of rows) resident parsed records would be
+        # the trainer's memory bill.
+        self.examples = IndexedJsonl(jsonl_path)
         if not self.examples:
             raise ValueError(f"empty dataset {jsonl_path}")
         self.store = feature_store

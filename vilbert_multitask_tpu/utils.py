@@ -1,7 +1,9 @@
-"""Small shared utilities with security-relevant, must-not-diverge logic."""
+"""Small shared utilities: single-home logic used across tiers — the
+security-relevant path-containment rule and the indexed dataset reader."""
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -23,3 +25,45 @@ def contained_path(root: str, candidate: str) -> Optional[str]:
     except ValueError:  # different drives / mixed abs-rel (windows)
         return None
     return full
+
+
+class IndexedJsonl:
+    """Random-access JSONL without loading the dataset into memory.
+
+    One startup scan records byte offsets of non-empty lines; reads seek
+    and parse on demand. At 12-in-1 training scale (hundreds of thousands
+    to millions of examples — e.g. Conceptual Captions) the resident cost
+    is one int per line instead of every parsed record, which is what lets
+    JsonlTaskData's stateless random draws (train/loop.py) run over real
+    dataset sizes. The file must not change underneath (offsets are
+    captured once); parsing is per-access, so hot loops that revisit few
+    indices can wrap accesses in their own cache.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        offsets = []
+        with open(path, "rb") as f:
+            pos = f.tell()
+            for raw in f:
+                if raw.strip():
+                    offsets.append(pos)
+                pos += len(raw)
+        self._offsets = offsets
+        self._f = open(path, "rb")
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def __getitem__(self, i: int):
+        if not -len(self) <= i < len(self):
+            raise IndexError(i)
+        self._f.seek(self._offsets[i])
+        return json.loads(self._f.readline())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def close(self) -> None:
+        self._f.close()

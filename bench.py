@@ -35,9 +35,12 @@ Env knobs:
 - ``BENCH_PROFILE_DIR`` capture a ``jax.profiler`` device trace of one
   warm round-robin pass into this directory (inspect with TensorBoard /
   xprof) — the diagnosis artifact for any surprising hardware number.
-- ``BENCH_WALL_BUDGET_S`` (3300) total wall budget for the orchestrator:
+- ``BENCH_WALL_BUDGET_S`` (7200) total wall budget for the orchestrator:
   attempts are sized to fit what remains, and no attempt starts that cannot
   finish inside it — a dead tunnel burns cheap probes, not 1800 s children.
+  Generous by default: probe cycles are cheap, a tunnel recovering late in
+  the window still gets its attempt, and a tighter outer ``timeout`` just
+  triggers the kill trap's best-so-far JSON instead.
 
 Kill-resilience: SIGTERM/SIGINT (what ``timeout`` sends before SIGKILL)
 emits the best-so-far JSON line — the headline measurement if one is in
@@ -604,7 +607,7 @@ def main() -> None:
                 and os.environ.get("BENCH_PROBE", "1") not in ("", "0"))
     probe_timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
     probe_backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF_S", "45"))
-    wall_budget_s = float(os.environ.get("BENCH_WALL_BUDGET_S", "3300"))
+    wall_budget_s = float(os.environ.get("BENCH_WALL_BUDGET_S", "7200"))
     # Below this remaining-time floor a measurement attempt cannot plausibly
     # finish (engine init alone is ~30 s + compile ~60 s + measure ~90 s,
     # all behind a tunnel with minutes of jitter) — stop and report instead.

@@ -105,3 +105,29 @@ def test_dropout_rng_training_mode(tiny_config, rng):
         params, **inputs, deterministic=False, rngs={"dropout": jax.random.PRNGKey(2)}
     )
     assert not np.allclose(d1.vil_prediction, d2.vil_prediction)
+
+
+def test_config_json_roundtrip(tmp_path):
+    """from_json_file loads the reference config format: a full round trip
+    (to_json -> file -> from_json_file) reproduces every field, unknown
+    keys are ignored (reference JSONs carry torch-only fields), and the
+    json list form of the biattention ids maps back to the typed tuple
+    semantics."""
+    import dataclasses
+    import json as _json
+
+    from vilbert_multitask_tpu.config import ViLBertConfig
+
+    cfg = ViLBertConfig().tiny(hidden_size=96, num_attention_heads=8)
+    p = tmp_path / "bert_config.json"
+    raw = _json.loads(cfg.to_json())
+    raw["torch_only_field"] = {"ignored": True}  # unknown keys tolerated
+    p.write_text(_json.dumps(raw))
+    back = ViLBertConfig.from_json_file(str(p))
+    a, b = dataclasses.asdict(cfg), dataclasses.asdict(back)
+    a["v_biattention_id"] = list(a["v_biattention_id"])
+    a["t_biattention_id"] = list(a["t_biattention_id"])
+    b["v_biattention_id"] = list(b["v_biattention_id"])
+    b["t_biattention_id"] = list(b["t_biattention_id"])
+    assert a == b
+    assert back.hidden_size == 96

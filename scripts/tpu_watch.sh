@@ -3,28 +3,38 @@
 # capture the JSON + stderr log. Loops until a bench JSON with a non-null
 # value exists or the watcher is killed. Round-4 driver aid: the round-3
 # bench artifact was lost to a tunnel outage (VERDICT r3 §weak-1).
+#
+# Usage: tpu_watch.sh [OUT_PREFIX] [ROUND_TAG]
+#   OUT_PREFIX — prefix for probe/bench scratch files (default /root/repo/.bench_r05)
+#   ROUND_TAG  — suffix for the committed artifacts (default r05):
+#                TRAIN_SMOKE_<tag>.json, DETECT_BENCH_<tag>.json
 set -u
-OUT=${1:-/root/repo/.bench_r04}
+OUT=${1:-/root/repo/.bench_r05}
+TAG=${2:-r05}
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-240}
 SLEEP=${SLEEP:-300}
+# bench.py budgets its own wall clock, but if the parent python hangs before
+# the budget logic engages (import-time backend hang) the loop would stall
+# forever — bound it from outside too (ADVICE r4 #3).
+BENCH_OUTER_TIMEOUT=${BENCH_OUTER_TIMEOUT:-$(( ${BENCH_WALL_BUDGET_S:-3300} + 300 ))}
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout "$PROBE_TIMEOUT" python -c "import jax; d=jax.devices(); print(d)" >"$OUT.probe" 2>&1; then
     echo "[$ts] PROBE_OK: $(cat "$OUT.probe" | tail -1)"
     echo "[$ts] launching bench..."
-    python /root/repo/bench.py >"$OUT.json" 2>"$OUT.stderr"
+    timeout "$BENCH_OUTER_TIMEOUT" python /root/repo/bench.py >"$OUT.json" 2>"$OUT.stderr"
     rc=$?
     echo "[$(date -u +%H:%M:%S)] bench rc=$rc json=$(cat "$OUT.json" 2>/dev/null | tail -1 | head -c 400)"
     if python -c "import json,sys; d=json.load(open('$OUT.json')); sys.exit(0 if d.get('value') is not None else 1)" 2>/dev/null; then
       echo "DONE: non-null bench value captured"
       echo "[$(date -u +%H:%M:%S)] train smoke (50 tiny steps)..."
       timeout 1800 python /root/repo/scripts/tpu_train_smoke.py --steps 50 \
-        --out /root/repo/TRAIN_SMOKE_r04.json >"$OUT.train" 2>&1 \
+        --out "/root/repo/TRAIN_SMOKE_${TAG}.json" >"$OUT.train" 2>&1 \
         && echo "train smoke ok: $(tail -1 "$OUT.train" | head -c 300)" \
         || echo "train smoke FAILED rc=$? (see $OUT.train)"
       echo "[$(date -u +%H:%M:%S)] live-extractor bench (full canvas)..."
       timeout 1800 python /root/repo/scripts/tpu_detect_bench.py \
-        --out /root/repo/DETECT_BENCH_r04.json >"$OUT.detect" 2>&1 \
+        --out "/root/repo/DETECT_BENCH_${TAG}.json" >"$OUT.detect" 2>&1 \
         && echo "detect bench ok: $(tail -1 "$OUT.detect" | head -c 300)" \
         || echo "detect bench rc=$? (a recorded blowup is still a result; see $OUT.detect)"
       exit 0

@@ -16,7 +16,7 @@ SLEEP=${SLEEP:-300}
 # bench.py budgets its own wall clock, but if the parent python hangs before
 # the budget logic engages (import-time backend hang) the loop would stall
 # forever — bound it from outside too (ADVICE r4 #3).
-BENCH_OUTER_TIMEOUT=${BENCH_OUTER_TIMEOUT:-$(( ${BENCH_WALL_BUDGET_S:-3300} + 300 ))}
+BENCH_OUTER_TIMEOUT=${BENCH_OUTER_TIMEOUT:-$(( ${BENCH_WALL_BUDGET_S:-7200} + 300 ))}
 while true; do
   ts=$(date -u +%H:%M:%S)
   if timeout "$PROBE_TIMEOUT" python -c "import jax; d=jax.devices(); print(d)" >"$OUT.probe" 2>&1; then

@@ -23,7 +23,14 @@ from vilbert_multitask_tpu.config import ViLBertConfig
 
 torch = pytest.importorskip("torch")
 
-from tests.torch_oracle import TorchViLBertOracle  # noqa: E402
+from tests.torch_oracle import (  # noqa: E402
+    TorchViLBertOracle,
+    flax_forward as _flax_forward,
+    numpy_state_dict as _numpy_state_dict,
+    oracle_inputs,
+    random_oracle as _random_oracle,
+    torch_forward as _torch_forward,
+)
 
 KEYS_FILE = (pathlib.Path(__file__).resolve().parents[1]
              / "vilbert_multitask_tpu" / "checkpoint"
@@ -41,70 +48,7 @@ def _tiny_cfg() -> ViLBertConfig:
     return ViLBertConfig().tiny()
 
 
-def _random_oracle(cfg, seed=0):
-    torch.manual_seed(seed)
-    oracle = TorchViLBertOracle(cfg).double()
-    with torch.no_grad():
-        for p in oracle.parameters():
-            p.uniform_(-0.35, 0.35)
-    oracle.eval()
-    return oracle
-
-
-def _inputs(cfg, batch=2, n_text=9, n_regions=7, seed=1):
-    rng = np.random.default_rng(seed)
-    input_ids = rng.integers(0, cfg.vocab_size, (batch, n_text))
-    segment_ids = np.zeros((batch, n_text), np.int64)
-    input_mask = np.ones((batch, n_text), np.int64)
-    input_mask[:, -2:] = 0  # exercise the text mask path
-    image_mask = np.ones((batch, n_regions), np.int64)
-    image_mask[:, -3:] = 0  # and the region mask path
-    features = rng.normal(size=(batch, n_regions, cfg.v_feature_size))
-    spatials = rng.random((batch, n_regions, 5))
-    task_ids = rng.integers(0, cfg.num_task_tokens, (batch, 1))
-    return dict(input_ids=input_ids.astype(np.int64),
-                features=features.astype(np.float64),
-                spatials=spatials.astype(np.float64),
-                segment_ids=segment_ids, input_mask=input_mask,
-                image_mask=image_mask, task_ids=task_ids.astype(np.int64))
-
-
-def _torch_forward(oracle, inp):
-    with torch.no_grad():
-        out = oracle(*(torch.from_numpy(inp[k]) for k in (
-            "input_ids", "features", "spatials", "segment_ids",
-            "input_mask", "image_mask", "task_ids")))
-    return {k: (v.numpy() if v is not None else None) for k, v in out.items()}
-
-
-def _numpy_state_dict(oracle):
-    return {k: v.detach().numpy().copy()
-            for k, v in oracle.state_dict().items()}
-
-
-def _flax_forward(cfg, params, inp):
-    import jax
-
-    from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks
-
-    with jax.enable_x64(True):
-        import jax.numpy as jnp
-
-        model = ViLBertForVLTasks(cfg, dtype=jnp.float64)
-        out = model.apply(
-            {"params": params},
-            jnp.asarray(inp["input_ids"], jnp.int32),
-            jnp.asarray(inp["features"], jnp.float64),
-            jnp.asarray(inp["spatials"], jnp.float64),
-            jnp.asarray(inp["segment_ids"], jnp.int32),
-            jnp.asarray(inp["input_mask"], jnp.int32),
-            jnp.asarray(inp["image_mask"], jnp.int32),
-            None,
-            jnp.asarray(inp["task_ids"], jnp.int32),
-            deterministic=True,
-            compute_pretraining_heads=True,
-        )
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+_inputs = oracle_inputs
 
 
 HEADS = ("vil_prediction", "vil_prediction_gqa", "vil_logit",
@@ -169,6 +113,32 @@ def test_swapped_bridge_direction_breaks_parity():
     got = _flax_forward(cfg, params, inp)
     diff = np.abs(got.vil_prediction - golden["vil_prediction"]).max()
     assert diff > PERTURB_MIN, "swapped bridge direction went undetected"
+
+
+@pytest.mark.slow
+def test_full_serving_config_parity(tmp_path):
+    """End-to-end logit parity at the FULL serving config (280M params).
+
+    The tiny-config golden test above cannot catch a transpose that is only
+    shape-legal at serving widths (1024x1024 square kernels, fused-QKV repack
+    at 1024-dim, 3129/1533-wide heads) — SURVEY §7 risk (a) at the scale
+    where it bites. scripts/parity_full.py is the committed-artifact
+    generator (PARITY_FULL.json); this wraps the same run so the proof
+    re-executes at round boundaries."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "parity_full",
+        pathlib.Path(__file__).resolve().parents[1] / "scripts"
+        / "parity_full.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.run(str(tmp_path / "parity.json"))
+    assert report["n_params"] > 250_000_000, report["n_params"]
+    assert report["passed"], (
+        f"full-config conversion parity broke: worst head err "
+        f"{report['worst_max_abs_err']:.3e} > {report['atol']:.0e}; "
+        f"per-head: { {h: v['max_abs_err'] for h, v in report['heads'].items()} }")
 
 
 def test_upstream_key_inventory_pinned():

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
 import torch
 import torch.nn as nn
 import torch.nn.functional as F
@@ -341,6 +342,88 @@ class _Cls(nn.Module):
         super().__init__()
         self.predictions = _TextPredictions(cfg, word_embedding)
         self.imagePredictions = _ImagePredictions(cfg)
+
+
+# --------------------------------------------------------------------------
+# Shared parity harness: one copy of the oracle-vs-Flax plumbing, used by the
+# tiny-config tests (tests/test_checkpoint_oracle.py) AND the full-serving-
+# config artifact generator (scripts/parity_full.py), so the model.apply call
+# signature and input construction cannot drift between the two.
+
+
+def random_oracle(cfg: ViLBertConfig, seed: int = 0,
+                  scale: float = 0.35) -> "TorchViLBertOracle":
+    """Seeded f64 oracle with uniform(-scale, scale) weights. The tiny-config
+    tests use 0.35; at serving widths (1024-dim trunks) that saturates
+    softmaxes/GELUs within a few layers, so the full-config run uses 0.05."""
+    torch.manual_seed(seed)
+    oracle = TorchViLBertOracle(cfg).double()
+    with torch.no_grad():
+        for p in oracle.parameters():
+            p.uniform_(-scale, scale)
+    oracle.eval()
+    return oracle
+
+
+def oracle_inputs(cfg: ViLBertConfig, batch: int = 2, n_text: int = 9,
+                  n_regions: int = 7, seed: int = 1,
+                  text_mask_tail: int = 2, region_mask_tail: int = 3) -> dict:
+    """Random f64 inputs exercising both mask paths (trailing zeros)."""
+    rng = np.random.default_rng(seed)
+    input_ids = rng.integers(0, cfg.vocab_size, (batch, n_text))
+    input_mask = np.ones((batch, n_text), np.int64)
+    input_mask[:, n_text - text_mask_tail:] = 0
+    image_mask = np.ones((batch, n_regions), np.int64)
+    image_mask[:, n_regions - region_mask_tail:] = 0
+    return dict(
+        input_ids=input_ids.astype(np.int64),
+        features=rng.normal(
+            size=(batch, n_regions, cfg.v_feature_size)).astype(np.float64),
+        spatials=rng.random((batch, n_regions, 5)).astype(np.float64),
+        segment_ids=np.zeros((batch, n_text), np.int64),
+        input_mask=input_mask, image_mask=image_mask,
+        task_ids=rng.integers(
+            0, cfg.num_task_tokens, (batch, 1)).astype(np.int64),
+    )
+
+
+def torch_forward(oracle: "TorchViLBertOracle", inp: dict) -> dict:
+    with torch.no_grad():
+        out = oracle(*(torch.from_numpy(inp[k]) for k in (
+            "input_ids", "features", "spatials", "segment_ids",
+            "input_mask", "image_mask", "task_ids")))
+    return {k: (v.numpy() if v is not None else None) for k, v in out.items()}
+
+
+def numpy_state_dict(oracle: "TorchViLBertOracle") -> dict:
+    return {k: v.detach().numpy().copy()
+            for k, v in oracle.state_dict().items()}
+
+
+def flax_forward(cfg: ViLBertConfig, params: dict, inp: dict):
+    """f64 ViLBertForVLTasks forward over converted params (all heads on)."""
+    import jax
+
+    from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks
+
+    with jax.enable_x64(True):
+        import jax.numpy as jnp
+
+        model = ViLBertForVLTasks(cfg, dtype=jnp.float64)
+        out = model.apply(
+            {"params": params},
+            jnp.asarray(inp["input_ids"], jnp.int32),
+            jnp.asarray(inp["features"], jnp.float64),
+            jnp.asarray(inp["spatials"], jnp.float64),
+            jnp.asarray(inp["segment_ids"], jnp.int32),
+            jnp.asarray(inp["input_mask"], jnp.int32),
+            jnp.asarray(inp["image_mask"], jnp.int32),
+            None,
+            jnp.asarray(inp["task_ids"], jnp.int32),
+            deterministic=True,
+            compute_pretraining_heads=True,
+        )
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
 
 
 class TorchViLBertOracle(nn.Module):

@@ -8,6 +8,11 @@ JSONL schemas (one example per line):
 - grounding:    {"expression": str, "image": key, "gt_box": [x1,y1,x2,y2]}
                 (pixel coords in the original image)
 - retrieval:    {"caption": str, "images": [key, ...], "target": 0-based idx}
+- retrieval_gallery: {"caption": str, "image": key}
+                (Flickr30k protocol: every caption ranks against the FULL
+                gallery — by default the distinct ``image`` keys of the
+                dataset, ~1k for the Flickr30k test split — not the ≤10
+                uploaded candidates of the demo task)
 - nlvr2:        {"caption": str, "images": [key1, key2], "label": true|false}
 
 Image keys resolve through the engine's FeatureStore (basename-sans-extension
@@ -20,9 +25,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import time
 from typing import Any, Dict, Iterable, List
 
+from vilbert_multitask_tpu.config import TASK_REGISTRY
 from vilbert_multitask_tpu.evals import metrics as M
 
 
@@ -119,6 +126,92 @@ class Evaluator:
                 "n": len(examples), "R@1": r1 / n, "R@5": r5 / n,
                 "R@10": r10 / n}
 
+    def eval_retrieval_gallery(self, examples: Iterable[Dict],
+                               task_id: int = 7,
+                               gallery: List[str] | None = None,
+                               chunk: int | None = None) -> Dict:
+        """Benchmark-protocol image retrieval: rank each caption against an
+        N-image gallery (BASELINE "Flickr30k IR R@1"; N≈1000), vs the demo
+        task's ≤10 uploaded candidates (reference worker.py:278-284 scores
+        only the uploaded set — demo parity lives in :meth:`eval_retrieval`).
+
+        The gallery is split into ≤``chunk``-image task-7 requests whose raw
+        per-image ``vil_logit`` scores are comparable across forwards (each
+        batch row scores (caption, image) independently; the softmax in
+        decode_ranking is presentation only). run_many packs the chunk
+        requests of ``batch`` captions into throughput-bucket-sized
+        forwards, and the device input cache keeps gallery features
+        resident after the first caption — each later caption ships only
+        its text.
+
+        The target's rank counts strictly-greater scores, so ties break in
+        the model's favor (a deterministic, standard choice).
+        """
+        examples = list(examples)
+        if gallery is None:
+            gallery = [e["image"] for e in examples]
+        # Dataset order, first occurrence wins — the standard protocol
+        # galleries are exactly the split's distinct images. Explicit
+        # galleries dedupe too: a repeated key would waste a forward and
+        # shift chunk boundaries without changing any rank.
+        gallery = list(dict.fromkeys(gallery))
+        spec = TASK_REGISTRY[task_id]
+        if chunk is None:
+            chunk = min(spec.max_images,
+                        self.engine.cfg.engine.max_batch_rows())
+        if not (spec.min_images <= chunk <= spec.max_images):
+            raise ValueError(
+                f"chunk={chunk} outside task {task_id}'s "
+                f"{spec.min_images}..{spec.max_images} images/request")
+        missing = {e["image"] for e in examples} - set(gallery)
+        if missing:
+            raise ValueError(
+                f"{len(missing)} target images absent from the gallery, "
+                f"e.g. {sorted(missing)[:3]}")
+        chunks = [gallery[i : i + chunk]
+                  for i in range(0, len(gallery), chunk)]
+        if len(chunks) > 1 and len(chunks[-1]) < spec.min_images:
+            # Undersized tail: merge the last two chunks and re-split into
+            # halves so BOTH stay >= min_images (shaving one element off the
+            # donor could push it under the gate too, e.g. chunk=2 over 5
+            # images). When even halves can't both clear the gate (combined
+            # size 3 at min 2) keep one merged chunk — combined = chunk +
+            # tail <= max + (min-1), and min*2 <= max for every registry
+            # task, so a merged fallback chunk always fits max_images.
+            merged = chunks[-2] + chunks[-1]
+            half = len(merged) // 2
+            if half >= spec.min_images and len(merged) - half <= spec.max_images:
+                chunks[-2:] = [merged[:-half], merged[-half:]]
+            else:
+                chunks[-2:] = [merged]
+        ranks: List[int] = []
+        step = max(1, self.batch)
+        for i in range(0, len(examples), step):
+            window = examples[i : i + step]
+            reqs = [self.engine.prepare_from_store(task_id, e["caption"], c)
+                    for e in window for c in chunks]
+            results = self.engine.run_many(reqs)
+            for j, e in enumerate(window):
+                scores: Dict[str, float] = {}
+                for res in results[j * len(chunks):(j + 1) * len(chunks)]:
+                    for entry in res.ranking:
+                        scores[entry["image"]] = entry["score"]
+                target = scores[e["image"]]
+                ranks.append(1 + sum(
+                    1 for img, s in scores.items()
+                    if s > target and img != e["image"]))
+        n = max(len(ranks), 1)
+        return {"metric": "retrieval_gallery_recall", "task_id": task_id,
+                "n": len(ranks), "n_gallery": len(gallery),
+                "chunk": chunk,
+                "R@1": sum(r <= 1 for r in ranks) / n,
+                "R@5": sum(r <= 5 for r in ranks) / n,
+                "R@10": sum(r <= 10 for r in ranks) / n,
+                # statistics.median == the protocol "Med r" (np.median):
+                # mean of the two middles on even counts.
+                "median_rank": (float(statistics.median(ranks))
+                                if ranks else None)}
+
     def eval_nlvr2(self, examples: Iterable[Dict], task_id: int = 12) -> Dict:
         examples = list(examples)
         results = self._run_multi_image(
@@ -139,16 +232,17 @@ class Evaluator:
         "grounding": ("eval_grounding", 11),
         "visual7w": ("eval_grounding", 4),
         "retrieval": ("eval_retrieval", 7),
+        "retrieval_gallery": ("eval_retrieval_gallery", 7),
         "nlvr2": ("eval_nlvr2", 12),
     }
 
-    def run(self, task: str, examples: Iterable[Dict]) -> Dict:
+    def run(self, task: str, examples: Iterable[Dict], **kwargs) -> Dict:
         if task not in self.EVAL_FNS:
             raise ValueError(f"unknown eval task {task!r}; "
                              f"one of {sorted(self.EVAL_FNS)}")
         fn_name, task_id = self.EVAL_FNS[task]
         t0 = time.perf_counter()
-        out = getattr(self, fn_name)(examples, task_id=task_id)
+        out = getattr(self, fn_name)(examples, task_id=task_id, **kwargs)
         out["wall_s"] = round(time.perf_counter() - t0, 3)
         return out
 
@@ -162,6 +256,13 @@ def main(argv=None) -> None:
                    help="precomputed feature dir")
     p.add_argument("--checkpoint", default=None, help="Orbax params dir")
     p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--gallery", default=None,
+                   help="retrieval_gallery only: file of image keys (one "
+                        "per line) to rank against instead of the "
+                        "dataset's distinct targets")
+    p.add_argument("--gallery-chunk", type=int, default=None,
+                   help="retrieval_gallery only: images per scoring "
+                        "request (default: task max, 10)")
     from vilbert_multitask_tpu.config import (
         FrameworkConfig,
         add_backend_args,
@@ -183,8 +284,15 @@ def main(argv=None) -> None:
         params = restore_params(args.checkpoint)
     engine = InferenceEngine(cfg, params=params,
                              feature_store=FeatureStore(args.features))
+    kwargs = {}
+    if args.task == "retrieval_gallery":
+        if args.gallery:
+            with open(args.gallery) as f:
+                kwargs["gallery"] = [ln.strip() for ln in f if ln.strip()]
+        if args.gallery_chunk:
+            kwargs["chunk"] = args.gallery_chunk
     result = Evaluator(engine, batch=args.batch).run(
-        args.task, load_jsonl(args.data))
+        args.task, load_jsonl(args.data), **kwargs)
     print(json.dumps(result))
 
 

@@ -243,9 +243,9 @@ def _measure_throughput(engine, cfg, *, n: int = 160):
         results = engine.run_many(reqs, chunk_rows=chunk_rows)
         dt = time.perf_counter() - t0
         assert len(results) == n
-        # Padded rows count as real work the chunking pays for.
-        rows = sum(cfg.engine.row_bucket_for(min(chunk_rows, n - i))
-                   for i in range(0, n, chunk_rows))
+        # Padded rows count as real work the chunking pays for; the plan
+        # comes from the engine (the single copy of the grouping math).
+        rows = engine.padded_rows([1] * n, chunk_rows=chunk_rows)
         tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
         return round(n / dt, 2), round(tflops, 4)
 
@@ -296,18 +296,9 @@ def _measure_throughput_mixed(engine, cfg, *, groups_n: int = 8):
     results = engine.run_many(reqs)
     dt = time.perf_counter() - t0
     assert len(results) == len(reqs)
-    # Mirror run_many's grouping for the padded-row FLOP accounting.
-    max_bucket = cfg.engine.max_batch_rows()
-    counts: dict = {}
-    for _, _, n in pattern:
-        counts[n] = counts.get(n, 0) + groups_n
-    rows = 0
-    for n, k in counts.items():
-        cap = max_bucket // n
-        full, tail = divmod(k, cap)
-        rows += full * cfg.engine.row_bucket_for(cap * n)
-        if tail:
-            rows += cfg.engine.row_bucket_for(tail * n)
+    # Padded-row FLOP accounting rides run_many's OWN plan (engine.padded_
+    # rows) — not a re-derivation that could drift from the real grouping.
+    rows = engine.padded_rows([r.n_images for r in reqs])
     tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
     return {"batch_qps_mixed": round(len(reqs) / dt, 2),
             "batch_tflops_mixed": round(tflops, 4),

@@ -130,6 +130,29 @@ def test_throughput_bucket_chunking(tiny_framework_cfg, features_dir):
             eng.run_many(reqs, chunk_rows=bad)
 
 
+def test_chunk_plan_is_run_manys_grouping(engine):
+    """ADVICE r4 #4: the bench's FLOP accounting consumes engine.chunk_plan/
+    padded_rows instead of re-deriving the arithmetic — pin the plan's
+    semantics here so a grouping change breaks a test, not the artifact.
+    Tiny engine: image buckets (1,2,4,8), no throughput buckets → max 8."""
+    counts = [1, 2, 1, 4, 2, 1, 1]  # mixed single/pair/quad backlog
+    plan = engine.chunk_plan(counts)
+    # group by image count, cap = 8//n, input order kept inside groups
+    assert plan == [[0, 2, 5, 6], [1, 4], [3]]
+    # every chunk packs ≤ the max bucket and spans one image count
+    for chunk in plan:
+        ns = {counts[i] for i in chunk}
+        assert len(ns) == 1 and sum(counts[i] for i in chunk) <= 8
+    assert sorted(i for c in plan for i in c) == list(range(len(counts)))
+    # padded rows: 4→4, 4→4, 4→4 under buckets (1,2,4,8)
+    assert engine.padded_rows(counts) == 12
+    # chunk_rows override changes the plan the same way run_many chunks
+    assert engine.chunk_plan([1] * 6, chunk_rows=4) == [[0, 1, 2, 3], [4, 5]]
+    assert engine.padded_rows([1] * 6, chunk_rows=4) == 4 + 2
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.chunk_plan([9])
+
+
 def test_prepare_clips_oversized_feature_files(engine):
     """Feature files with more boxes than the engine's region budget clip to
     the top-N (files are confidence-ordered) instead of erroring."""

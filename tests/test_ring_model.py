@@ -1,0 +1,165 @@
+"""MODEL-level ring attention (VERDICT r4 #4).
+
+tests/test_ring_attention.py proves the ring PRIMITIVE exact; these tests
+prove the MODEL runs sequence-parallel: a ViLBertForVLTasks built with a
+RingContext routes visual-stream self-attention through shard_map/ppermute
+over the mesh's sp axis (structurally asserted on the jaxpr), reproduces the
+dense model's outputs from the SAME param tree, and stays dense below the
+region-count threshold or on non-dividing shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu.config import MeshConfig, ViLBertConfig
+from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks
+from vilbert_multitask_tpu.parallel import build_mesh
+from vilbert_multitask_tpu.parallel.ring import RingContext
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+N_REGIONS = 16  # divisible by sp=4, above the test threshold
+BATCH = 4  # divisible by dp=2; even for the NLVR2 head
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return build_mesh(MeshConfig(dp=2, tp=1, sp=4))
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # XLA attention (no Pallas interpret-mode slowdown on CPU); the ring
+    # path composes with the kernels identically — it replaces the same
+    # FusedSelfAttention computation.
+    return dataclasses.replace(
+        ViLBertConfig().tiny(),
+        use_pallas_self_attention=False, use_pallas_coattention=False)
+
+
+def _inputs(cfg, n_regions=N_REGIONS, batch=BATCH, n_text=9, seed=3):
+    rng = np.random.default_rng(seed)
+    inp = dict(
+        input_ids=jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, n_text)), jnp.int32),
+        features=jnp.asarray(
+            rng.normal(size=(batch, n_regions, cfg.v_feature_size)),
+            jnp.float32),
+        spatials=jnp.asarray(
+            rng.random((batch, n_regions, 5)), jnp.float32),
+        segment_ids=jnp.zeros((batch, n_text), jnp.int32),
+        input_mask=jnp.ones((batch, n_text), jnp.int32),
+        image_mask=jnp.asarray(
+            rng.integers(0, 2, (batch, n_regions)) | np.eye(
+                1, n_regions, dtype=np.int64)[0], jnp.int32),
+        task_ids=jnp.asarray(
+            rng.integers(0, cfg.num_task_tokens, (batch, 1)), jnp.int32),
+    )
+    return inp
+
+
+def _apply(model, params, inp):
+    return model.apply(
+        {"params": params}, inp["input_ids"], inp["features"],
+        inp["spatials"], inp["segment_ids"], inp["input_mask"],
+        inp["image_mask"], None, inp["task_ids"], deterministic=True)
+
+
+def test_model_runs_sequence_parallel_and_matches_dense(sp_mesh, cfg):
+    """Same params, two instances: the ring model must (a) actually shard —
+    its jaxpr contains the ring's ppermute collective — and (b) reproduce
+    the dense outputs (exact attention, fp32 tolerance)."""
+    ctx = RingContext(sp_mesh, sp_axis="sp", batch_axis="dp",
+                      min_seq=N_REGIONS)
+    dense = ViLBertForVLTasks(cfg, dtype=jnp.float32)
+    ring = ViLBertForVLTasks(cfg, ring_v=ctx, dtype=jnp.float32)
+    inp = _inputs(cfg)
+    params = dense.init(
+        jax.random.PRNGKey(0), inp["input_ids"], inp["features"],
+        inp["spatials"], inp["segment_ids"], inp["input_mask"],
+        inp["image_mask"], None, inp["task_ids"], deterministic=True,
+    )["params"]
+
+    jaxpr = str(jax.make_jaxpr(lambda p, i: _apply(ring, p, i))(params, inp))
+    assert "ppermute" in jaxpr, "ring model compiled without the collective"
+    dense_jaxpr = str(
+        jax.make_jaxpr(lambda p, i: _apply(dense, p, i))(params, inp))
+    assert "ppermute" not in dense_jaxpr
+
+    out_d = _apply(dense, params, inp)
+    out_r = _apply(ring, params, inp)
+    for head in ("vil_prediction", "vil_logit", "vision_logit",
+                 "vil_binary_prediction", "linguisic_logit"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(out_r, head)),
+            np.asarray(getattr(out_d, head)),
+            atol=3e-5, rtol=1e-4, err_msg=f"{head} diverges under sp")
+
+
+def test_model_ring_works_under_jit(sp_mesh, cfg):
+    """The serving/training path jits the forward; shard_map must compose."""
+    ctx = RingContext(sp_mesh, sp_axis="sp", batch_axis="dp",
+                      min_seq=N_REGIONS)
+    ring = ViLBertForVLTasks(cfg, ring_v=ctx, dtype=jnp.float32)
+    inp = _inputs(cfg)
+    params = ring.init(
+        jax.random.PRNGKey(1), inp["input_ids"], inp["features"],
+        inp["spatials"], inp["segment_ids"], inp["input_mask"],
+        inp["image_mask"], None, inp["task_ids"], deterministic=True,
+    )["params"]
+    out = jax.jit(lambda p, i: _apply(ring, p, i).vil_prediction)(params, inp)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_model_ring_composes_with_tensor_parallel(cfg):
+    """tp×sp mesh: the ring's head axis rides tp (no per-layer all-gather
+    of Megatron head-sharded Q/K/V), and the outputs still match dense.
+    from_mesh includes head_axis only when tp is real."""
+    mesh = build_mesh(MeshConfig(dp=1, tp=2, sp=4))
+    ctx = RingContext.from_mesh(mesh, min_seq=N_REGIONS)
+    assert ctx is not None and ctx.head_axis == "tp"
+    assert ctx.batch_axis is None  # dp=1 → no batch sharding
+    dense = ViLBertForVLTasks(cfg, dtype=jnp.float32)
+    ring = ViLBertForVLTasks(cfg, ring_v=ctx, dtype=jnp.float32)
+    inp = _inputs(cfg, batch=2)
+    params = dense.init(
+        jax.random.PRNGKey(4), inp["input_ids"], inp["features"],
+        inp["spatials"], inp["segment_ids"], inp["input_mask"],
+        inp["image_mask"], None, inp["task_ids"], deterministic=True,
+    )["params"]
+    out_r = jax.jit(lambda p, i: _apply(ring, p, i).vil_prediction)(
+        params, inp)
+    out_d = _apply(dense, params, inp).vil_prediction
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_d),
+                               atol=3e-5, rtol=1e-4)
+
+
+def test_threshold_and_divisibility_keep_dense(sp_mesh, cfg):
+    """Below min_seq, or when the region count doesn't divide sp, the
+    static gate keeps the dense program — no collective in the jaxpr."""
+    dense_ctx = RingContext(sp_mesh, sp_axis="sp", batch_axis="dp",
+                            min_seq=N_REGIONS * 4)  # threshold above N
+    model = ViLBertForVLTasks(cfg, ring_v=dense_ctx, dtype=jnp.float32)
+    inp = _inputs(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), inp["input_ids"], inp["features"],
+        inp["spatials"], inp["segment_ids"], inp["input_mask"],
+        inp["image_mask"], None, inp["task_ids"], deterministic=True,
+    )["params"]
+    jaxpr = str(jax.make_jaxpr(lambda p, i: _apply(model, p, i))(params, inp))
+    assert "ppermute" not in jaxpr
+
+    # 15 regions: clears a low threshold but does not divide sp=4.
+    ctx = RingContext(sp_mesh, sp_axis="sp", batch_axis="dp", min_seq=8)
+    model15 = ViLBertForVLTasks(cfg, ring_v=ctx, dtype=jnp.float32)
+    inp15 = _inputs(cfg, n_regions=15)
+    jaxpr15 = str(
+        jax.make_jaxpr(lambda p, i: _apply(model15, p, i))(params, inp15))
+    assert "ppermute" not in jaxpr15

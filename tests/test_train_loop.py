@@ -260,6 +260,40 @@ def test_mesh_checkpoint_resume_is_bit_exact(train_cfg, tmp_path):
         np.testing.assert_array_equal(x, y)
 
 
+def test_indexed_jsonl_concurrent_reads(tmp_path):
+    """ADVICE r4 #2: seek()+readline() on the shared handle is a critical
+    section — 8 threads hammering random indices must every one parse the
+    record the index names (interleaved seeks would cross-read lines)."""
+    import threading
+
+    from vilbert_multitask_tpu.utils import IndexedJsonl
+
+    path = tmp_path / "d.jsonl"
+    with open(path, "w") as f:
+        for i in range(200):
+            f.write(json.dumps({"i": i, "pad": "x" * (i % 37)}) + "\n")
+    with IndexedJsonl(str(path)) as ds:
+        assert len(ds) == 200
+        errors = []
+
+        def reader(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(300):
+                i = int(rng.integers(0, 200))
+                rec = ds[i]
+                if rec["i"] != i:
+                    errors.append((i, rec))
+
+        threads = [threading.Thread(target=reader, args=(s,))
+                   for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+    assert ds._f.closed  # context manager released the handle
+
+
 def test_jsonl_clips_overprovisioned_store(train_cfg, tmp_path):
     """A store entry with more boxes than the region budget is clipped to
     the top max_regions-1 (confidence order), not a crash — same contract

@@ -254,6 +254,12 @@ class EngineConfig:
     # they mean to exercise.
     use_pallas_coattention: bool = True
     use_pallas_self_attention: bool = True  # 128-aligned streams only
+    # Region-count threshold for sequence-parallel ring attention on the
+    # visual stream (parallel/ring.py): buckets at or above it route
+    # v-stream self-attention through the mesh's "sp" axis (MeshConfig.sp
+    # > 1), below it the dense path wins (ppermute latency beats the HBM
+    # saving at demo scale — 101 regions). Static per compiled bucket.
+    ring_min_regions: int = 256
     # Text/label assets. None → the committed defaults in assets/ (real
     # file-loading code paths; swap the files for the genuine bert-base-
     # uncased vocab / reference label pickles to get score parity).
@@ -362,6 +368,11 @@ class MeshConfig:
 
     dp: int = -1  # -1: all remaining devices
     tp: int = 1
+    # Sequence-parallel axis size (ring attention over the visual stream,
+    # parallel/ring.py). 1 = no sp axis; >1 adds an "sp" mesh axis and
+    # engine/trainer route long region sets through the ring when they
+    # clear EngineConfig.ring_min_regions.
+    sp: int = 1
     axis_names: Sequence[str] = ("dp", "tp")
 
 
@@ -385,6 +396,12 @@ class ServingConfig:
     # None → open, matching the reference broker's default-credentials posture
     # (sender.py:12-15); set it when workers cross host boundaries.
     worker_token: str | None = None
+    # Shared secret for the ADMIN WRITE surface (POST /admin/*). The
+    # reference's Django admin is login-gated (demo/admin.py); here edits
+    # mutate the persistent task catalog, so when set, writes require
+    # ``Authorization: Bearer <token>`` (admin.html prompts for it).
+    # None → open — acceptable only on the loopback default bind.
+    admin_token: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -130,7 +130,17 @@ class InferenceEngine:
             use_pallas_coattention=ecfg.use_pallas_coattention,
             use_pallas_self_attention=ecfg.use_pallas_self_attention,
         )
-        self.model = ViLBertForVLTasks(model_cfg, dtype=self.compute_dtype)
+        # Sequence-parallel routing: a mesh with a real "sp" axis
+        # (MeshConfig.sp > 1) opts the visual stream into ring attention
+        # for buckets at/above ring_min_regions — the long-context path.
+        # Demo-scale buckets (≤101 regions) stay dense; the decision is
+        # static per compiled bucket (RingContext.engages).
+        from vilbert_multitask_tpu.parallel.ring import RingContext
+
+        self._ring_v = RingContext.from_mesh(
+            mesh, min_seq=ecfg.ring_min_regions)
+        self.model = ViLBertForVLTasks(model_cfg, ring_v=self._ring_v,
+                                       dtype=self.compute_dtype)
         # Default assets: the committed vocab/label files — real file-loading
         # paths (reference worker.py:537-539, 299-315), not in-memory toys.
         self.tokenizer = tokenizer or FullTokenizer.from_vocab_file(
@@ -386,6 +396,7 @@ class InferenceEngine:
                 self.model.config,
                 use_pallas_coattention=False,
                 use_pallas_self_attention=False),
+            ring_v=self._ring_v,
             dtype=self.compute_dtype)
         self._model_gen += 1
         self._compiled.clear()  # memory hygiene; staleness is keyed out
@@ -718,30 +729,11 @@ class InferenceEngine:
         # HBM at once.
         from collections import deque
 
-        # Chunk at the largest throughput bucket when configured: the
-        # 10-row retrieval cap on the image buckets doesn't bound a packed
-        # chunk — a 32-row chunk keeps the MXU fed instead of paying a
-        # dispatch round trip per 10 rows (mid-size tails land on the
-        # intermediate buckets). ``chunk_rows`` overrides for callers
-        # tuning backlog shape (and the bench's 10-vs-32 comparison); it
-        # must fit a compiled bucket.
-        max_bucket = (chunk_rows if chunk_rows is not None
-                      else self.cfg.engine.max_batch_rows())
-        self.cfg.engine.row_bucket_for(max_bucket)  # raises on <1 or misfit
-        # Group by image count (results keep input order via positions).
-        groups: Dict[int, List[Tuple[int, PreparedRequest]]] = {}
-        for pos, r in enumerate(reqs):
-            if r.n_images > max_bucket:
-                raise ValueError(
-                    f"request with {r.n_images} images exceeds the "
-                    f"{max_bucket}-row chunk; raise throughput_buckets or "
-                    f"chunk_rows")
-            groups.setdefault(r.n_images, []).append((pos, r))
-        chunks: List[List[Tuple[int, PreparedRequest]]] = []
-        for n, items in sorted(groups.items()):
-            cap = max_bucket // n  # >=1: n > max_bucket raised above
-            chunks.extend(items[i : i + cap]
-                          for i in range(0, len(items), cap))
+        plan = self.chunk_plan([r.n_images for r in reqs],
+                               chunk_rows=chunk_rows)
+        chunks: List[List[Tuple[int, PreparedRequest]]] = [
+            [(pos, reqs[pos]) for pos in idxs] for idxs in plan
+        ]
         out: List[Optional[dec.TaskResult]] = [None] * len(reqs)
         pending: deque = deque()
         dec_s = 0.0
@@ -774,6 +766,52 @@ class InferenceEngine:
     # HBM) during a chunked run_many: 2 gives full upload/compute overlap;
     # more only grows the memory footprint.
     _MAX_INFLIGHT_CHUNKS = 2
+
+    def chunk_plan(self, image_counts: Sequence[int], *,
+                   chunk_rows: Optional[int] = None) -> List[List[int]]:
+        """run_many's grouping, exposed: request indices per chunk.
+
+        Chunks pack at the largest throughput bucket when configured — the
+        10-row retrieval cap on the image buckets doesn't bound a packed
+        chunk; a 32-row chunk keeps the MXU fed instead of paying a
+        dispatch round trip per 10 rows (mid-size tails land on the
+        intermediate buckets). ``chunk_rows`` overrides for callers tuning
+        backlog shape (and the bench's 10-vs-32 comparison); it must fit a
+        compiled bucket. Requests group by image count so multi-image row
+        spans stay consecutive and NLVR2 pairs keep even alignment.
+
+        This is the ONE copy of the grouping arithmetic: run_many executes
+        it and the bench's padded-row FLOP accounting consumes it
+        (:meth:`padded_rows`), so a change to the chunking cannot silently
+        skew the reported TFLOP/s (ADVICE r4 #4).
+        """
+        max_bucket = (chunk_rows if chunk_rows is not None
+                      else self.cfg.engine.max_batch_rows())
+        self.cfg.engine.row_bucket_for(max_bucket)  # raises on <1 or misfit
+        groups: Dict[int, List[int]] = {}
+        for pos, n in enumerate(image_counts):
+            if n > max_bucket:
+                raise ValueError(
+                    f"request with {n} images exceeds the "
+                    f"{max_bucket}-row chunk; raise throughput_buckets or "
+                    f"chunk_rows")
+            groups.setdefault(n, []).append(pos)
+        chunks: List[List[int]] = []
+        for n, items in sorted(groups.items()):
+            cap = max_bucket // n  # >=1: n > max_bucket raised above
+            chunks.extend(items[i : i + cap]
+                          for i in range(0, len(items), cap))
+        return chunks
+
+    def padded_rows(self, image_counts: Sequence[int], *,
+                    chunk_rows: Optional[int] = None) -> int:
+        """Total device rows a run_many over these requests dispatches,
+        INCLUDING bucket padding — the denominator-side work term for
+        throughput/TFLOP accounting."""
+        counts = list(image_counts)
+        return sum(
+            self.cfg.engine.row_bucket_for(sum(counts[i] for i in chunk))
+            for chunk in self.chunk_plan(counts, chunk_rows=chunk_rows))
 
     def _dispatch_many(self, reqs: Sequence[PreparedRequest]):
         """Pack one ≤max-bucket chunk and dispatch its forward; returns the

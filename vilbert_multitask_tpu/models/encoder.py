@@ -17,7 +17,7 @@ Reference capability: BertEncoder in the external ``vilbert`` package
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import TYPE_CHECKING, List, Optional, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -25,9 +25,19 @@ from flax import linen as nn
 from vilbert_multitask_tpu.config import ViLBertConfig
 from vilbert_multitask_tpu.models.layers import ConnectionLayer, TransformerLayer
 
+if TYPE_CHECKING:
+    from vilbert_multitask_tpu.parallel.ring import RingContext
+
 
 class TwoStreamEncoder(nn.Module):
+    """``ring_v`` routes VISUAL-stream self-attention through sequence-
+    parallel ring attention (parallel/ring.py) when the region count clears
+    the context's threshold — regions are the long axis (video frames,
+    tiled detections); the text stream is capped at 38 tokens by the
+    pipeline and always stays dense, as does the cross-stream bridge."""
+
     config: ViLBertConfig
+    ring_v: Optional["RingContext"] = None
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
@@ -64,6 +74,7 @@ class TwoStreamEncoder(nn.Module):
                 attention_dropout=cfg.v_attention_probs_dropout_prob,
                 layer_norm_eps=cfg.layer_norm_eps,
                 use_pallas=cfg.use_pallas_self_attention,
+                ring=self.ring_v,
                 dtype=self.dtype,
                 name=f"v_layer_{i}",
             )

@@ -9,7 +9,7 @@ package driven from worker.py:286-289 — re-designed as Flax modules.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import TYPE_CHECKING, Optional, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -18,6 +18,9 @@ from vilbert_multitask_tpu.ops.attention import (
     CrossAttention,
     FusedSelfAttention,
 )
+
+if TYPE_CHECKING:
+    from vilbert_multitask_tpu.parallel.ring import RingContext
 
 # Exact (erf) GELU: the BERT/ViLBERT family is trained with the exact form,
 # and flax's default is the tanh approximation — close enough to train, close
@@ -73,7 +76,12 @@ class FeedForward(nn.Module):
 
 
 class TransformerLayer(nn.Module):
-    """One single-stream encoder layer (text or visual)."""
+    """One single-stream encoder layer (text or visual).
+
+    ``ring`` opts the self-attention into the sequence-parallel path (see
+    FusedSelfAttention); param structure is identical either way, so dense
+    and ring instances share checkpoints.
+    """
 
     hidden_size: int
     num_heads: int
@@ -83,6 +91,7 @@ class TransformerLayer(nn.Module):
     attention_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
     use_pallas: bool = False
+    ring: Optional["RingContext"] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -92,6 +101,7 @@ class TransformerLayer(nn.Module):
             num_heads=self.num_heads,
             dropout_rate=self.attention_dropout,
             use_pallas=self.use_pallas,
+            ring=self.ring,
             dtype=self.dtype,
             name="attention",
         )(x, mask_bias, deterministic=deterministic)

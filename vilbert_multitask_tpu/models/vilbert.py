@@ -69,13 +69,15 @@ class ViLBertModel(nn.Module):
     """Trunk: embeddings + two-stream encoder + poolers."""
 
     config: ViLBertConfig
+    ring_v: Optional[Any] = None  # parallel.ring.RingContext — see encoder
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         cfg = self.config
         self.embeddings = TextEmbeddings(cfg, dtype=self.dtype)
         self.v_embeddings = ImageEmbeddings(cfg, dtype=self.dtype)
-        self.encoder = TwoStreamEncoder(cfg, dtype=self.dtype)
+        self.encoder = TwoStreamEncoder(cfg, ring_v=self.ring_v,
+                                        dtype=self.dtype)
         self.t_pooler = Pooler(cfg.bi_hidden_size, dtype=self.dtype)
         self.v_pooler = Pooler(cfg.bi_hidden_size, dtype=self.dtype)
 
@@ -113,14 +115,21 @@ class ViLBertModel(nn.Module):
 
 
 class ViLBertForVLTasks(nn.Module):
-    """Trunk + all 9 heads; output order matches the reference 10-tuple."""
+    """Trunk + all 9 heads; output order matches the reference 10-tuple.
+
+    ``ring_v`` (parallel.ring.RingContext) opts the visual stream into
+    sequence-parallel ring attention on the context's mesh — the
+    long-context serving/training path. Dense and ring instances have
+    identical param trees (checkpoints are interchangeable).
+    """
 
     config: ViLBertConfig
+    ring_v: Optional[Any] = None
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         cfg = self.config
-        self.bert = ViLBertModel(cfg, dtype=self.dtype)
+        self.bert = ViLBertModel(cfg, ring_v=self.ring_v, dtype=self.dtype)
         bi = cfg.bi_hidden_size
         self.vil_prediction = SimpleClassifier(
             bi * 2, cfg.num_labels, cfg.layer_norm_eps, dtype=self.dtype

@@ -13,11 +13,14 @@ package (driven from worker.py:286-289); redesigned, not translated.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import TYPE_CHECKING, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+if TYPE_CHECKING:  # annotation only — parallel.ring is imported lazily
+    from vilbert_multitask_tpu.parallel.ring import RingContext
 
 
 def mask_to_bias(mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
@@ -68,12 +71,21 @@ class FusedSelfAttention(nn.Module):
 
     ``num_heads * head_dim == hidden`` always holds for both streams
     (768/12 and 1024/8 in the serving config).
+
+    ``ring`` (a :class:`~vilbert_multitask_tpu.parallel.ring.RingContext`)
+    opts this layer into sequence-parallel exact attention over the mesh's
+    ``sp`` axis when the (static) sequence length clears the context's
+    region-count threshold — the long-context path for region sets beyond
+    one chip's HBM. Attention-probs collection and dropout keep the dense
+    path (the ring never materializes the (Nq, Nk) matrix, same contract
+    as the Pallas kernel below).
     """
 
     hidden_size: int
     num_heads: int
     dropout_rate: float = 0.1
     use_pallas: bool = False
+    ring: Optional["RingContext"] = None  # parallel/ring.py
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -84,6 +96,18 @@ class FusedSelfAttention(nn.Module):
         shape = (*x.shape[:-1], self.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         use_dropout = not deterministic and self.dropout_rate > 0.0
+        if (self.ring is not None and not use_dropout
+                and self.ring.engages(x.shape[1], x.shape[0])):
+            from vilbert_multitask_tpu.parallel.ring import ring_self_attention
+
+            # Accumulate at >= fp32 (the same promotion the dense softmax
+            # uses) — under bf16 compute the online-softmax recurrence is
+            # where precision matters most.
+            ctx = ring_self_attention(
+                self.ring, q, k, v, mask_bias,
+                dtype=jnp.promote_types(self.dtype, jnp.float32))
+            ctx = ctx.astype(self.dtype)
+            return ctx.reshape(*x.shape[:-1], self.hidden_size), None
         # Kernel path: self-attention probs are never surfaced (the encoder
         # discards them, and the reference's attn_data_list carries only the
         # co-attention maps), so only dropout and tile fit gate this.

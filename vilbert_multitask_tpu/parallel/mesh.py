@@ -24,24 +24,35 @@ from vilbert_multitask_tpu.config import MeshConfig
 def build_mesh(
     cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None
 ) -> Mesh:
-    """Build a ``(dp, tp)`` mesh from the config over the given devices.
+    """Build a ``(dp, tp)`` — or ``(dp, tp, sp)`` when ``cfg.sp > 1`` — mesh
+    from the config over the given devices.
 
-    ``dp == -1`` means "all remaining devices after tp" — the serving default,
-    so one binary works on 1-chip dev boxes and full slices alike.
+    ``dp == -1`` means "all remaining devices after tp (and sp)" — the
+    serving default, so one binary works on 1-chip dev boxes and full
+    slices alike. The sp axis is innermost: ring attention's per-step
+    ppermute rides neighbor ICI links, which an innermost axis maps to on
+    a TPU torus.
     """
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     tp = max(1, cfg.tp)
+    sp = max(1, cfg.sp)
+    model = tp * sp
     if cfg.dp > 0:
         dp = cfg.dp
     else:
-        if len(devices) % tp:
-            raise ValueError(f"{len(devices)} devices not divisible by tp={tp}")
-        dp = len(devices) // tp
-    if dp * tp > len(devices):
+        if len(devices) % model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by tp*sp={model}")
+        dp = len(devices) // model
+    if dp * model > len(devices):
         raise ValueError(
-            f"mesh {dp}x{tp} needs {dp * tp} devices, have {len(devices)}"
+            f"mesh {dp}x{tp}x{sp} needs {dp * model} devices, "
+            f"have {len(devices)}"
         )
+    if sp > 1:
+        grid = np.asarray(devices[: dp * model]).reshape(dp, tp, sp)
+        return Mesh(grid, (*cfg.axis_names, "sp"))
     grid = np.asarray(devices[: dp * tp]).reshape(dp, tp)
     return Mesh(grid, tuple(cfg.axis_names))
 

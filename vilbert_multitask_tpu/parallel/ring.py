@@ -24,12 +24,101 @@ No Python-level loop over devices: one ``lax.fori_loop`` inside
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class RingContext:
+    """Everything the MODEL needs to route self-attention through the ring.
+
+    Carried as a (hashable) Flax module attribute down
+    ``ViLBertForVLTasks → TwoStreamEncoder → TransformerLayer →
+    FusedSelfAttention`` — the mesh cannot live in :class:`ViLBertConfig`
+    (that tree is JSON-serializable checkpoint metadata). ``min_seq`` is the
+    region-count threshold: below it the dense path wins (the ring's P
+    ppermute hops cost more than they save on the demo's 101 regions; the
+    threshold decision is static per compiled bucket, so each bucket
+    compiles exactly one of the two paths).
+    """
+
+    mesh: Mesh
+    sp_axis: str = "sp"
+    batch_axis: Optional[str] = None
+    # Shard the HEAD axis over tp inside the ring: the Megatron rules
+    # already shard the QKV projections' output features on tp, so keeping
+    # heads tp-sharded through the attention avoids an all-gather per
+    # layer and the tp-redundant recompute of identical attention.
+    head_axis: Optional[str] = None
+    min_seq: int = 256  # authoritative serving knob: EngineConfig.ring_min_regions
+
+    @classmethod
+    def from_mesh(cls, mesh: Optional[Mesh], *, min_seq: int,
+                  sp_axis: str = "sp", batch_axis: str = "dp",
+                  head_axis: str = "tp") -> Optional["RingContext"]:
+        """The ONE construction rule engine, trainer, and dryrun share:
+        None unless the mesh has a real sp axis; batch/head axes included
+        only when those mesh axes are real."""
+        if mesh is None or mesh.shape.get(sp_axis, 1) <= 1:
+            return None
+        return cls(
+            mesh, sp_axis=sp_axis,
+            batch_axis=(batch_axis
+                        if mesh.shape.get(batch_axis, 1) > 1 else None),
+            head_axis=(head_axis
+                       if mesh.shape.get(head_axis, 1) > 1 else None),
+            min_seq=min_seq)
+
+    def engages(self, seq_len: int, batch: Optional[int] = None) -> bool:
+        """Static (trace-time) decision: ring only when the sp axis is real,
+        the sequence clears the threshold, and shapes divide the axes."""
+        sp = self.mesh.shape.get(self.sp_axis, 1)
+        if sp <= 1 or seq_len < self.min_seq or seq_len % sp:
+            return False
+        if self.batch_axis is not None:
+            b = self.mesh.shape.get(self.batch_axis, 1)
+            if batch is not None and batch % b:
+                return False
+        return True
+
+
+def ring_self_attention(ctx: RingContext, q, k, v, mask_bias, *,
+                        dtype=jnp.float32):
+    """Sequence-parallel self-attention for use INSIDE a jitted model.
+
+    Global-array in/out, (B, N, H, D) each; ``mask_bias`` additive
+    (B, 1, 1, N) or None. Unlike :func:`make_ring_attention` (a standalone
+    jitted op that device_puts its inputs), this is a bare ``shard_map``
+    the caller's surrounding ``jit`` composes with — activations reshard
+    onto the sp axis at entry and back at exit, and XLA overlaps the
+    per-step ppermute with the next block's compute.
+    """
+    b_ax = ctx.batch_axis
+    # Head axis rides tp when it divides (composes with the Megatron
+    # tp-sharded QKV projections — no per-layer all-gather); otherwise
+    # heads replicate, which is merely the pre-tp-aware behavior.
+    h_ax = ctx.head_axis
+    if h_ax is not None and q.shape[2] % ctx.mesh.shape.get(h_ax, 1):
+        h_ax = None
+    qkv_spec = P(b_ax, ctx.sp_axis, h_ax)
+    specs = (qkv_spec, qkv_spec, qkv_spec,
+             P(b_ax, None, None, ctx.sp_axis))
+    if mask_bias is None:
+        mask_bias = jnp.zeros((q.shape[0], 1, 1, k.shape[1]), dtype)
+    mapped = jax.shard_map(
+        functools.partial(ring_attention_shard, axis_name=ctx.sp_axis,
+                          dtype=dtype),
+        mesh=ctx.mesh,
+        in_specs=specs,
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v, mask_bias.astype(dtype))
 
 
 def _online_update(carry, scores, v_blk):

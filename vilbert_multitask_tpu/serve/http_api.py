@@ -344,7 +344,21 @@ class ApiServer:
                 Django admin edits Tasks rows and QuestionAnswer text).
                 POST /admin/tasks/<id> and /admin/questionanswer/<id> take a
                 JSON object of editable fields and return the updated row
-                with the same scrubbing the browse endpoints apply."""
+                with the same scrubbing the browse endpoints apply.
+
+                Gated behind ``ServingConfig.admin_token`` when set (the
+                reference admin sits behind Django auth, demo/admin.py);
+                unset keeps the open loopback-dev posture, but an edited
+                row persists across reboots (the reseed never overwrites
+                ``edited=1`` rows), so cross-host deployments must set it."""
+                token = getattr(api.serving, "admin_token", None)
+                if token:
+                    import hmac
+
+                    auth = self.headers.get("Authorization", "")
+                    if not hmac.compare_digest(auth, f"Bearer {token}"):
+                        self._json(401, {"error": "bad admin token"})
+                        return
                 parts = path.strip("/").split("/")
                 if len(parts) != 3 or parts[1] not in (
                         "tasks", "questionanswer"):

@@ -306,6 +306,11 @@ class JsonlTaskData:
     def __len__(self) -> int:
         return len(self.examples)
 
+    def close(self) -> None:
+        """Release the dataset's file handle (owned here — the IndexedJsonl
+        is constructed by and private to this loader)."""
+        self.examples.close()
+
     def _question_of(self, ex: Dict) -> str:
         for k in ("question", "expression", "caption", "premise"):
             if k in ex:
@@ -584,10 +589,33 @@ class Trainer:
                     f"LoopConfig.retrieval_group_size="
                     f"{loop.retrieval_group_size}")
         # Training computes in bf16 like serving; master params stay f32.
+        # A mesh with a real "sp" axis routes the visual stream through
+        # ring attention for ≥ring_min_regions buckets (long-context
+        # training).
+        from vilbert_multitask_tpu.parallel.ring import RingContext
+
+        ring_v = RingContext.from_mesh(mesh,
+                                       min_seq=cfg.engine.ring_min_regions)
+        if ring_v is not None and cfg.model.v_attention_probs_dropout_prob > 0:
+            # The ring never materializes attention probs, so probs-dropout
+            # has no ring implementation — FusedSelfAttention keeps the
+            # dense path whenever dropout is live, which on TRAIN steps is
+            # every step. Silence would mean the sp axis the user asked for
+            # does nothing exactly where it matters (long sequences, OOM).
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "MeshConfig.sp > 1 but v_attention_probs_dropout_prob=%.3f "
+                "keeps TRAIN steps on dense attention (ring attention has "
+                "no probs-dropout path). Set "
+                "v_attention_probs_dropout_prob=0.0 to train "
+                "sequence-parallel; eval/serving forwards ring regardless.",
+                cfg.model.v_attention_probs_dropout_prob)
         self.model = ViLBertForVLTasks(
             dataclasses.replace(cfg.model,
                                 use_pallas_coattention=False,
                                 use_pallas_self_attention=False),
+            ring_v=ring_v,
             dtype=jnp.dtype(cfg.engine.compute_dtype))
         self.tx = default_optimizer(
             learning_rate=loop.learning_rate, warmup_steps=loop.warmup_steps,

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Optional
 
 
@@ -51,6 +52,10 @@ class IndexedJsonl:
                 pos += len(raw)
         self._offsets = offsets
         self._f = open(path, "rb")
+        # seek()+readline() is a two-step critical section on ONE shared
+        # handle: two readers interleaving (a threaded loader, two samplers
+        # over one dataset) would parse lines at the wrong offsets.
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self._offsets)
@@ -58,8 +63,10 @@ class IndexedJsonl:
     def __getitem__(self, i: int):
         if not -len(self) <= i < len(self):
             raise IndexError(i)
-        self._f.seek(self._offsets[i])
-        return json.loads(self._f.readline())
+        with self._lock:
+            self._f.seek(self._offsets[i])
+            raw = self._f.readline()
+        return json.loads(raw)
 
     def __iter__(self):
         for i in range(len(self)):
@@ -67,3 +74,15 @@ class IndexedJsonl:
 
     def close(self) -> None:
         self._f.close()
+
+    def __enter__(self) -> "IndexedJsonl":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # belt-and-braces; close() is the real contract
+        try:
+            self._f.close()
+        except Exception:
+            pass

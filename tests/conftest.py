@@ -23,6 +23,90 @@ jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402
 
+# ------------------------------------------------------- fast/slow profiles
+# The full suite is compile-bound (~40-75 min on this 1-core box) — slow
+# enough that nobody runs it mid-edit, which is how regressions slip in
+# (VERDICT r4 weak-5). The compile-heaviest tests (>= ~20 s measured call
+# time, mostly mesh-sharded trainer loops and multi-bucket warmups) carry
+# the ``slow`` marker so
+#
+#     pytest -m "not slow"     # fast profile, <10 min — the edit loop
+#     pytest                   # full suite — round boundaries / CI
+#
+# Centralized here (not per-file decorators) so the list is one reviewable
+# block; the collection hook FAILS if an entry stops matching a collected
+# test, so a rename can't silently un-slow anything. Parametrized tests
+# match on the base name.
+SLOW_TESTS = {
+    # trainer loops (optimizer steps × jit compiles, some mesh-sharded)
+    "test_multitask_smoke_trains_all_heads",
+    "test_checkpoint_resume_is_bit_exact",
+    "test_mesh_checkpoint_resume_is_bit_exact",
+    "test_mesh_sharded_training_loop",
+    "test_cli_main_synthetic_smoke",
+    "test_pretrain_jsonl_captions",
+    "test_loss_decreases_on_fixed_batch",
+    "test_retrieval_jsonl_group_layout",
+    "test_trainer_aborts_on_divergence",
+    "test_pretrain_head_trains",
+    "test_checkpoint_retention",
+    "test_eval_hook_scores_on_serving_path",
+    # train-step unit suites that grad-compile the full model
+    "test_dryrun_multichip_entry",
+    "test_sharded_train_step_on_mesh",
+    "test_loss_decreases_over_steps",
+    "test_remat_matches_plain_gradients",
+    # engine/serving paths that compile several buckets or a mesh twin
+    "test_mesh_sharded_run_many_matches_single_device",
+    "test_mesh_sharded_engine_matches_single_device",
+    "test_transfer_dtype_follows_compute_dtype",
+    "test_device_input_cache_lru_eviction",
+    "test_warmup_falls_back_to_xla_when_kernel_rejected",
+    "test_input_cache_stats_counts",
+    "test_parallel_warmup_compiles_all_buckets",
+    "test_serveapp_serves_through_mesh",
+    "test_throughput_bucket_chunking",
+    # end-to-end flows with their own engines/converters
+    "test_onboard_end_to_end",
+    "test_fallback_store_feeds_vilbert_forward",
+    "test_model_runs_sequence_parallel_and_matches_dense",
+    "test_golden_scores_are_falsifiable",
+    "test_golden_scores_exact",
+    "test_full_serving_config_parity",  # also marked inline (280M params)
+    # bench machinery that spawns subprocess children / XLA cost analyses
+    "test_probe_skipped_in_tiny_mode",
+    "test_dead_backend_probes_then_structured_failure",
+    "test_flops_estimate_vs_xla_cost_analysis",
+}
+
+
+_COLLECT_ERRORS = []
+
+
+def pytest_collectreport(report):
+    if report.failed:
+        _COLLECT_ERRORS.append(report.nodeid)
+
+
+def pytest_collection_modifyitems(config, items):
+    seen = set()
+    for item in items:
+        base = item.name.split("[")[0]
+        if base in SLOW_TESTS:
+            seen.add(base)
+            item.add_marker(pytest.mark.slow)
+    # Only enforce inventory on full, error-free collections: a -k/path-
+    # filtered run legitimately collects a subset, and a file that failed
+    # to collect already reports its own error — asserting here would bury
+    # that real failure under a bogus "renamed?" INTERNALERROR.
+    if (not _COLLECT_ERRORS
+            and config.args in ([], ["tests"], ["tests/"])
+            and len(items) > 150):
+        missing = SLOW_TESTS - seen
+        assert not missing, (
+            f"SLOW_TESTS entries match no collected test (renamed?): "
+            f"{sorted(missing)}")
+
 
 @pytest.fixture(scope="session")
 def tiny_config():

@@ -244,7 +244,7 @@ def _measure_throughput(engine, cfg, *, n: int = 160):
         dt = time.perf_counter() - t0
         assert len(results) == n
         # Padded rows count as real work the chunking pays for; the plan
-        # comes from the engine (the single copy of the grouping math).
+        # comes from the engine (the single copy of the packing math).
         rows = engine.padded_rows([1] * n, chunk_rows=chunk_rows)
         tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
         return round(n / dt, 2), round(tflops, 4)
@@ -291,13 +291,13 @@ def _measure_throughput_mixed(engine, cfg, *, groups_n: int = 8):
         for task_id, q, n in pattern:
             reqs.append(engine.prepare(task_id, q, regions[:n],
                                        cache_keys=keys[:n]))
-    engine.run_many(reqs[: len(pattern)])  # warm every group's bucket
+    engine.run_many(reqs[: len(pattern)])  # warm the packed-chunk buckets
     t0 = time.perf_counter()
     results = engine.run_many(reqs)
     dt = time.perf_counter() - t0
     assert len(results) == len(reqs)
     # Padded-row FLOP accounting rides run_many's OWN plan (engine.padded_
-    # rows) — not a re-derivation that could drift from the real grouping.
+    # rows) — not a re-derivation that could drift from the real packing.
     rows = engine.padded_rows([r.n_images for r in reqs])
     tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
     return {"batch_qps_mixed": round(len(reqs) / dt, 2),

@@ -130,27 +130,65 @@ def test_throughput_bucket_chunking(tiny_framework_cfg, features_dir):
             eng.run_many(reqs, chunk_rows=bad)
 
 
-def test_chunk_plan_is_run_manys_grouping(engine):
+def test_chunk_plan_is_run_manys_packing(engine):
     """ADVICE r4 #4: the bench's FLOP accounting consumes engine.chunk_plan/
     padded_rows instead of re-deriving the arithmetic — pin the plan's
-    semantics here so a grouping change breaks a test, not the artifact.
+    semantics here so a packing change breaks a test, not the artifact.
     Tiny engine: image buckets (1,2,4,8), no throughput buckets → max 8."""
     counts = [1, 2, 1, 4, 2, 1, 1]  # mixed single/pair/quad backlog
     plan = engine.chunk_plan(counts)
-    # group by image count, cap = 8//n, input order kept inside groups
-    assert plan == [[0, 2, 5, 6], [1, 4], [3]]
-    # every chunk packs ≤ the max bucket and spans one image count
-    for chunk in plan:
-        ns = {counts[i] for i in chunk}
-        assert len(ns) == 1 and sum(counts[i] for i in chunk) <= 8
+    # Mixed-count packing (round 5): evens first (2+4+2 fills a chunk),
+    # then the singles share one — 2 dispatches where per-count grouping
+    # paid 3.
+    assert plan == [[1, 3, 4], [0, 2, 5, 6]]
     assert sorted(i for c in plan for i in c) == list(range(len(counts)))
-    # padded rows: 4→4, 4→4, 4→4 under buckets (1,2,4,8)
-    assert engine.padded_rows(counts) == 12
+    for chunk in plan:
+        assert sum(counts[i] for i in chunk) <= 8
+        # even-count requests lead the chunk AND sit at even row offsets
+        # (the binary head pairs rows 2k/2k+1; decode reads offset//2)
+        offset, seen_odd = 0, False
+        for i in chunk:
+            if counts[i] % 2 == 0:
+                assert not seen_odd and offset % 2 == 0, (chunk, i)
+            else:
+                seen_odd = True
+            offset += counts[i]
+    assert engine.padded_rows(counts) == 8 + 4
     # chunk_rows override changes the plan the same way run_many chunks
     assert engine.chunk_plan([1] * 6, chunk_rows=4) == [[0, 1, 2, 3], [4, 5]]
     assert engine.padded_rows([1] * 6, chunk_rows=4) == 4 + 2
     with pytest.raises(ValueError, match="exceeds"):
         engine.chunk_plan([9])
+
+
+def test_mixed_count_chunk_decodes_match_solo(engine):
+    """Functional proof of the round-5 mixed packer: NLVR2 pairs, a
+    retrieval set, and singles packed into SHARED chunks must decode
+    identically to one-request-at-a-time runs — pair alignment, ranking
+    row spans, and label rows all survive mixed packing."""
+    reqs = [
+        _prep(engine, 1, "what is it", ["img_a.jpg"]),
+        _prep(engine, 12, "both contain dogs", ["img_a.jpg", "img_b.jpg"]),
+        _prep(engine, 13, "dogs play", ["img_b.jpg"]),
+        _prep(engine, 7, "a dog catching",
+              ["img_a.jpg", "img_b.jpg", "img_a.jpg", "img_b.jpg"]),
+        _prep(engine, 12, "both contain cats", ["img_b.jpg", "img_a.jpg"]),
+        _prep(engine, 15, "is it red", ["img_a.jpg"]),
+    ]
+    # 1+2+1+4+2+1 = 11 rows over max bucket 8 → two mixed chunks
+    plan = engine.chunk_plan([r.n_images for r in reqs])
+    assert len(plan) == 2 and any(
+        len({reqs[i].n_images for i in c}) > 1 for c in plan)
+    batched = engine.run_many(reqs)
+    for req, got in zip(reqs, batched):
+        _, solo = engine.run(req)
+        assert got.kind == solo.kind
+        if got.answers is not None:
+            assert [a["answer"] for a in got.answers] == \
+                [a["answer"] for a in solo.answers], req.spec.task_id
+        if got.ranking is not None:
+            assert [r["image"] for r in got.ranking] == \
+                [r["image"] for r in solo.ranking]
 
 
 def test_prepare_clips_oversized_feature_files(engine):

@@ -93,10 +93,10 @@ class Evaluator:
 
     def _run_multi_image(self, task_id: int, captions: List[str],
                          image_lists: List[List[str]]):
-        """Micro-batched multi-image forwards: run_many groups by image
-        count and packs each request's rows consecutively, so retrieval
-        candidate sets and NLVR2 pairs batch instead of paying one
-        dispatch per example (``batch`` counts examples per call)."""
+        """Micro-batched multi-image forwards: run_many packs mixed image
+        counts into shared chunks (each request's rows consecutive), so
+        retrieval candidate sets and NLVR2 pairs batch instead of paying
+        one dispatch per example (``batch`` counts examples per call)."""
         results = []
         for i in range(0, len(captions), self.batch):
             reqs = [

@@ -169,8 +169,9 @@ class ServeWorker:
     # ------------------------------------------------------- micro-batching
     def step_batch(self, max_jobs: Optional[int] = None) -> int:
         """Drain up to ``max_jobs`` queued jobs and serve the packable ones
-        through batched forwards (engine.run_many, which groups by image
-        count so NLVR2 pairs and retrieval candidate sets batch too);
+        through batched forwards (engine.run_many — mixed image counts
+        share chunks, so NLVR2 pairs, retrieval candidate sets, and
+        singles all pack into the same dispatches; see engine.chunk_plan);
         attention-map requests claimed along the way run individually
         (per-request forward flag). Returns jobs completed.
 

@@ -195,7 +195,24 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
         t = time.perf_counter()
         engine.run(req)
         cold_ms.append((time.perf_counter() - t) * 1e3)
+    # Dispatch floor: a trivial jitted op on a resident device array, timed
+    # the same way as a query. Separates per-dispatch overhead (tunnel RTT
+    # on the axon backend, PJRT launch cost locally) from model compute —
+    # without it a remote-tunnel p50 reads as "slow model" when it is
+    # mostly wire time.
+    import jax
+    import jax.numpy as jnp
+
+    tiny_fn = jax.jit(lambda x: x + 1.0)
+    resident = jax.device_put(jnp.zeros((8, 128), jnp.float32))
+    jax.block_until_ready(tiny_fn(resident))  # compile outside the timing
+    floor_ms = []
+    for _ in range(20):
+        t = time.perf_counter()
+        jax.block_until_ready(tiny_fn(resident))
+        floor_ms.append((time.perf_counter() - t) * 1e3)
     return {
+        "dispatch_floor_ms": round(statistics.median(floor_ms), 3),
         "warmup_s": round(warm_s, 1),
         "n_queries": len(lat_ms),
         "cold_p50_ms": round(statistics.median(cold_ms), 3),
@@ -367,6 +384,7 @@ def run_measurement() -> None:
         "input_cache": engine.input_cache_stats,
         "forward_p50_ms": stats["forward_p50_ms"],
         "decode_p50_ms": stats["decode_p50_ms"],
+        "dispatch_floor_ms": stats["dispatch_floor_ms"],
         "n_queries": stats["n_queries"],
         "buckets_timed": stats["buckets"],
         "init_s": round(init_s, 1),

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Probe-only watcher: append a timestamped tunnel-health line every cycle.
+# Runs after the round's artifacts are in hand — keeps the uptime timeline
+# on record (VERDICT r4: "if the tunnel never lives, commit the probe
+# timeline as evidence") and tells the builder when a dead tunnel recovers.
+set -u
+LOG=${1:-/root/repo/BENCH_r05_probes.log}
+SLEEP=${SLEEP:-300}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-120}
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%S)
+  # No pipe on the probe itself: the if must test the python/timeout exit
+  # status, not a tail's (tpu_watch.sh uses the same direct pattern).
+  out=$(timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices()[0].device_kind)" 2>&1)
+  rc=$?
+  line=$(echo "$out" | tail -1 | head -c 160)
+  if [ $rc -eq 0 ]; then
+    echo "[$ts] probe OK: $line" >> "$LOG"
+  else
+    echo "[$ts] probe DEAD (rc=$rc): $line" >> "$LOG"
+  fi
+  sleep "$SLEEP"
+done

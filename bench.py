@@ -32,6 +32,12 @@ Env knobs:
 - ``BENCH_PROBE=0`` skip the pre-attempt backend probe (default ON for the
   hardware path; TINY mode never probes). ``BENCH_PROBE_TIMEOUT_S`` (240),
   ``BENCH_PROBE_BACKOFF_S`` (45) tune the probe cycle.
+- ``BENCH_SWEEP_ROWS`` comma-separated extra run_many chunk sizes (e.g.
+  ``64,128``) to time alongside the configured buckets — the chunk-size
+  knee finder for an execute-bound backend (round-5 hardware showed p50
+  barely moves from 1 to 10 rows, so bigger chunks are near-free qps).
+  Each size costs one extra bucket compile; the headline ``batch_qps``
+  becomes the best size measured.
 - ``BENCH_PROFILE_DIR`` capture a ``jax.profiler`` device trace of one
   warm round-robin pass into this directory (inspect with TensorBoard /
   xprof) — the diagnosis artifact for any surprising hardware number.
@@ -76,6 +82,23 @@ COMPARE_TIMEOUT_S = float(os.environ.get("BENCH_COMPARE_TIMEOUT_S", "900"))
 # Forced kernel selection for a child process ("0"/"1"); unset → config
 # defaults. The orchestrator sets 0 for the compare child.
 FORCE_PALLAS = os.environ.get("BENCH_PALLAS", "")
+# Extra run_many chunk sizes to time in the throughput pass (see docstring).
+# Malformed or non-positive entries are dropped, not raised: a bad env var
+# must never break the always-emit-JSON contract (the parse runs at import,
+# before the orchestrator's kill trap exists).
+def _parse_sweep(raw: str) -> tuple:
+    out = []
+    for s in raw.split(","):
+        try:
+            v = int(s)
+        except ValueError:
+            continue
+        if v > 0:
+            out.append(v)
+    return tuple(out)
+
+
+SWEEP_ROWS = _parse_sweep(os.environ.get("BENCH_SWEEP_ROWS", ""))
 
 
 def synth_regions(rng, cfg, n_boxes=100):
@@ -126,6 +149,11 @@ def _build_engine(pallas: bool | None):
     if pallas is not None:
         over.update(use_pallas_coattention=pallas,
                     use_pallas_self_attention=pallas)
+    if SWEEP_ROWS:
+        # Sweep sizes must be compiled row buckets before run_many can
+        # chunk at them; union with the configured ones.
+        over["throughput_buckets"] = tuple(sorted(
+            {*(cfg.engine.throughput_buckets or ()), *SWEEP_ROWS}))
     cfg = dataclasses.replace(
         cfg, engine=dataclasses.replace(cfg.engine, **over))
     return cfg, InferenceEngine(cfg)
@@ -230,12 +258,25 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
 def _measure_throughput(engine, cfg, *, n: int = 160):
     """Micro-batched serving throughput: ``run_many`` over single-image
     tasks — the BASELINE "full 12-task round-robin batch (shared trunk, all
-    heads hot)" mode. Measured at TWO chunk sizes so the round's artifact
+    heads hot)" mode. Measured per chunk size so the round's artifact
     records the throughput-bucket decision (VERDICT r3 weak-3): the
     10-row max image bucket (retrieval semantics, the round-3 ceiling) vs
-    the dedicated throughput bucket (32 by default) that exists purely to
-    keep the MXU fed. ``n`` divides both chunk sizes → no ragged tail."""
+    the dedicated throughput buckets that exist purely to keep the MXU
+    fed, plus any ``BENCH_SWEEP_ROWS`` knee-finder sizes. ``n`` is raised
+    to 2× the largest size (rounded to a multiple of it) so every size
+    gets at least two full chunks and the biggest has no ragged tail."""
     from vilbert_multitask_tpu.engine.flops import serving_forward_flops
+
+    max_img = max(cfg.engine.image_buckets)
+    tb = cfg.engine.max_batch_rows()
+    # Always time the max image bucket (the pre-throughput-bucket ceiling)
+    # and the largest configured bucket; BENCH_SWEEP_ROWS adds knee-finder
+    # sizes on top. Headline batch_qps = the best size measured.
+    sizes = sorted({max_img, tb, *SWEEP_ROWS})
+    biggest = max(sizes)
+    if n < 2 * biggest:
+        n = 2 * biggest
+    n = -(-n // biggest) * biggest  # round up: no ragged tail at `biggest`
 
     rng = np.random.default_rng(1)
     regions = [synth_regions(rng, cfg)]
@@ -255,32 +296,35 @@ def _measure_throughput(engine, cfg, *, n: int = 160):
     ]
 
     def timed(chunk_rows: int) -> tuple:
+        # Fair per-size comparison: time the largest multiple of the chunk
+        # size that fits in the request list, so no size pays a ragged tail
+        # dispatch the others don't (n is a multiple of the biggest size,
+        # so every size keeps >= half the requests).
+        n_s = (n // chunk_rows) * chunk_rows
         engine.run_many(reqs[:chunk_rows], chunk_rows=chunk_rows)  # warm
         t0 = time.perf_counter()
-        results = engine.run_many(reqs, chunk_rows=chunk_rows)
+        results = engine.run_many(reqs[:n_s], chunk_rows=chunk_rows)
         dt = time.perf_counter() - t0
-        assert len(results) == n
+        assert len(results) == n_s
         # Padded rows count as real work the chunking pays for; the plan
         # comes from the engine (the single copy of the packing math).
-        rows = engine.padded_rows([1] * n, chunk_rows=chunk_rows)
+        rows = engine.padded_rows([1] * n_s, chunk_rows=chunk_rows)
         tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
-        return round(n / dt, 2), round(tflops, 4)
+        return round(n_s / dt, 2), round(tflops, 4)
 
-    max_img = max(cfg.engine.image_buckets)
-    qps_img, tflops_img = timed(max_img)
-    out = {"batch_qps": qps_img, "batch_tflops": tflops_img,
-           "batch_chunk_rows": max_img}
-    tb = cfg.engine.max_batch_rows()
-    if tb and tb > max_img:
-        qps_tb, tflops_tb = timed(tb)
-        out.update({
-            f"batch_qps_b{max_img}": qps_img,
-            f"batch_tflops_b{max_img}": tflops_img,
-            "batch_qps": qps_tb, "batch_tflops": tflops_tb,
-            "batch_chunk_rows": tb,
-            "batch_speedup_vs_max_image_bucket": round(
-                qps_tb / max(qps_img, 1e-9), 3),
-        })
+    by_size = {s: timed(s) for s in sizes}
+    best = max(sizes, key=lambda s: by_size[s][0])
+    out = {}
+    for s in sizes:
+        if s != best:
+            out[f"batch_qps_b{s}"] = by_size[s][0]
+            out[f"batch_tflops_b{s}"] = by_size[s][1]
+    out.update({"batch_qps": by_size[best][0],
+                "batch_tflops": by_size[best][1],
+                "batch_chunk_rows": best})
+    if best != max_img:
+        out["batch_speedup_vs_max_image_bucket"] = round(
+            by_size[best][0] / max(by_size[max_img][0], 1e-9), 3)
     out.update(_measure_throughput_mixed(engine, cfg))
     return out
 
@@ -508,9 +552,12 @@ def _maybe_compare(headline: dict, timeout_s: float | None = None) -> dict:
     print("# compare child: XLA-attention engine...", file=sys.stderr)
     # BENCH_PROFILE_DIR cleared: the compare child would otherwise write an
     # indistinguishable pallas-off trace into the same diagnosis directory.
+    # BENCH_SWEEP_ROWS cleared too — only value/forward_p50 are read from
+    # the child, so a sweep there is extra compiles burning the compare
+    # timeout for discarded numbers.
     line, err = _run_child(min(COMPARE_TIMEOUT_S, timeout_s or COMPARE_TIMEOUT_S),
                            {"BENCH_PALLAS": "0", "BENCH_COMPARE": "0",
-                            "BENCH_PROFILE_DIR": ""})
+                            "BENCH_PROFILE_DIR": "", "BENCH_SWEEP_ROWS": ""})
     if line is None:
         print(f"# compare child failed ({err}); headline unchanged",
               file=sys.stderr)

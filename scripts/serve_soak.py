@@ -1,0 +1,206 @@
+"""End-to-end serving soak: the WHOLE stack under a burst of mixed jobs.
+
+Drives HTTP POST → durable queue → micro-batched worker → result store →
+websocket push as one system (the reference's full L0-L6 pipeline,
+SURVEY §1) and measures what no unit test does: end-to-end job latency
+(submit → result frame on the browser socket) and sustained jobs/s while
+the worker drains a backlog through ``run_many`` batched forwards.
+
+Runs on CPU with the tiny model by default (the serving tiers are
+host-side; the forward is not the subject here) and prints ONE JSON line
+plus an artifact file. ``--full`` uses the serving-size model — on a TPU
+window that makes this the full-system hardware soak.
+
+Usage: python scripts/serve_soak.py [--jobs 96] [--out SERVE_SOAK.json]
+       [--full]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import http.client
+import json
+import math
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+# Runnable from anywhere: sys.path[0] is scripts/, the package lives one up.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A soak's subject is the serving tiers, not the accelerator; default to
+# CPU unless the caller explicitly wants the hardware path (--full implies
+# whatever backend jax picks).
+
+
+def _build_cfg(root: str, full: bool):
+    from vilbert_multitask_tpu.config import (
+        EngineConfig,
+        FrameworkConfig,
+        ServingConfig,
+        ViLBertConfig,
+    )
+
+    model = ViLBertConfig() if full else ViLBertConfig().tiny()
+    engine = EngineConfig() if full else EngineConfig(
+        max_text_len=12, max_regions=9, num_features=8,
+        image_buckets=(1, 2, 4), throughput_buckets=(8, 16),
+        use_pallas_coattention=False, use_pallas_self_attention=False,
+    )
+    return FrameworkConfig(
+        model=model, engine=engine,
+        serving=ServingConfig(
+            queue_db_path=os.path.join(root, "queue.sqlite3"),
+            results_db_path=os.path.join(root, "results.sqlite3"),
+            media_root=os.path.join(root, "media"),
+            http_port=0, ws_port=0,
+        ),
+    )
+
+
+def _make_features(root: str, dim: int, n: int = 4) -> str:
+    import numpy as np
+
+    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.store import save_reference_npy
+
+    d = os.path.join(root, "features")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        boxes = np.array([[10, 10, 60, 60], [30, 20, 90, 80],
+                          [5, 40, 50, 95]], np.float32)
+        region = RegionFeatures(
+            features=rng.normal(size=(3, dim)).astype(np.float32),
+            boxes=boxes, image_width=100, image_height=100)
+        save_reference_npy(os.path.join(d, f"img_{i}.npy"), region,
+                           f"img_{i}")
+    return d
+
+
+# Mixed burst: single-image tasks, an NLVR2 pair, and a retrieval set —
+# the ragged backlog shape run_many's chunk packing exists for.
+PATTERN = [
+    (1, "what is in image number {i}", 1),
+    (15, "is the bowl right of the mug {i}", 1),
+    (13, "two dogs play in the snow {i}", 1),
+    (12, "both images contain wolves {i}", 2),
+    (7, "a dog catching a frisbee {i}", 4),
+]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--jobs", type=int, default=96)
+    p.add_argument("--out", default="SERVE_SOAK.json")
+    p.add_argument("--full", action="store_true",
+                   help="serving-size model on whatever backend jax picks")
+    args = p.parse_args(argv)
+
+    if not args.full:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from websockets.sync.client import connect
+
+    from vilbert_multitask_tpu.serve.app import ServeApp
+
+    root = tempfile.mkdtemp(prefix="serve_soak_")
+    cfg = _build_cfg(root, args.full)
+    feat = _make_features(root, cfg.model.v_feature_size)
+    t0 = time.perf_counter()
+    app = ServeApp(cfg, feature_root=feat)
+    app.warm()
+    app.start()
+    boot_s = time.perf_counter() - t0
+    print(f"# boot {boot_s:.1f}s: {app.boot_info}", file=sys.stderr)
+
+    sock = "soak-sock"
+    arrivals: dict = {}
+    done = threading.Event()
+
+    def ws_reader():
+        # done fires on ANY exit — a dropped frame or an error-only job
+        # must degrade to a partial report with real timestamps, not leave
+        # main() blocked on the full wait while makespan inflates.
+        try:
+            with connect(f"ws://127.0.0.1:{app.ws.bound_port}/chat/") as ws:
+                ws.send(sock)
+                ready.set()
+                while len(arrivals) < args.jobs:
+                    frame = json.loads(ws.recv(timeout=120))
+                    if "result" in frame:
+                        # Question text round-trips through the pipeline
+                        # lowercased; the embedded index makes each job's
+                        # result attributable for per-job latency.
+                        arrivals[frame["result"]["question"]] = (
+                            time.perf_counter())
+        finally:
+            done.set()
+
+    ready = threading.Event()
+    reader = threading.Thread(target=ws_reader, daemon=True)
+    reader.start()
+    assert ready.wait(timeout=30), "websocket never connected"
+
+    conn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                      timeout=30)
+    submitted: dict = {}
+    t_burst = time.perf_counter()
+    for i in range(args.jobs):
+        task_id, q_t, n_img = PATTERN[i % len(PATTERN)]
+        q = q_t.format(i=i)
+        body = json.dumps({
+            "task_id": task_id, "socket_id": sock, "question": q,
+            "image_list": [f"img_{k}.jpg" for k in range(n_img)],
+        })
+        conn.request("POST", "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        resp.read()
+        submitted[q.lower()] = time.perf_counter()
+
+    ok = done.wait(timeout=600)
+    app.stop()
+
+    lat_ms = sorted(
+        (arrivals[q] - t) * 1e3 for q, t in submitted.items()
+        if q in arrivals)
+    n_done = len(lat_ms)
+    # Throughput over the time results actually flowed: on a partial run
+    # the wait timeout must not land in the denominator.
+    makespan_s = ((max(arrivals.values()) - t_burst)
+                  if arrivals else time.perf_counter() - t_burst)
+    report = {
+        "metric": "serve_soak_qps",
+        "value": round(n_done / makespan_s, 2),
+        "unit": "jobs/s",
+        "jobs": args.jobs,
+        "completed": n_done,
+        "all_completed": bool(ok and n_done == args.jobs),
+        "e2e_p50_ms": round(statistics.median(lat_ms), 1) if lat_ms else None,
+        "e2e_p95_ms": (round(lat_ms[min(n_done - 1,
+                                        math.ceil(0.95 * n_done) - 1)], 1)
+                       if lat_ms else None),
+        "makespan_s": round(makespan_s, 2),
+        "boot_s": round(boot_s, 1),
+        "model": "full" if args.full else "tiny",
+        "backend": __import__("jax").default_backend(),
+        # Per-task request counts prove every family in the burst ran.
+        "tasks_served": sorted(
+            int(k) for k in app.worker.metrics.snapshot()["by_task"]),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report), flush=True)
+    return 0 if report["all_completed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

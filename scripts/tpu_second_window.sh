@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 second-window watcher: the headline artifacts are already in
+# hand (BENCH_r05.json + TRAIN_SMOKE + recorded detect blowup); if the
+# tunnel comes back, capture the follow-ups the first window couldn't:
+#   1. bench with the chunk-size sweep (64/128 knee) + dispatch floor
+#      -> BENCH_r05_sweep.json
+#   2. tiny-canvas live-extractor bench -> DETECT_BENCH_r05_tiny.json
+#      (full canvas killed the tunnel's remote compiler; tiny answers
+#      whether the graph class compiles at all on this backend)
+# Logs every probe to the round's probe log either way.
+set -u
+LOG=${1:-/root/repo/BENCH_r05_probes.log}
+SLEEP=${SLEEP:-300}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-120}
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%S)
+  out=$(timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices()[0].device_kind)" 2>&1)
+  rc=$?
+  line=$(echo "$out" | tail -1 | head -c 160)
+  if [ $rc -eq 0 ]; then
+    echo "[$ts] probe OK: $line" >> "$LOG"
+    echo "[$ts] second window open: sweep bench..." >> "$LOG"
+    BENCH_SWEEP_ROWS=64,128 BENCH_WALL_BUDGET_S=2400 \
+      timeout 2700 python /root/repo/bench.py \
+      >/root/repo/.bench_r05_sweep.json 2>/root/repo/.bench_r05_sweep.stderr
+    brc=$?
+    echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] sweep bench rc=$brc" >> "$LOG"
+    if python -c "import json,sys; d=json.load(open('/root/repo/.bench_r05_sweep.json')); sys.exit(0 if d.get('value') is not None else 1)" 2>/dev/null; then
+      cp /root/repo/.bench_r05_sweep.json /root/repo/BENCH_r05_sweep.json
+      echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] BENCH_r05_sweep.json captured" >> "$LOG"
+      timeout 1200 python /root/repo/scripts/tpu_detect_bench.py --tiny \
+        --out /root/repo/DETECT_BENCH_r05_tiny.json \
+        >/root/repo/.bench_r05.detect_tiny 2>&1
+      echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] tiny detect rc=$? (JSON written either way)" >> "$LOG"
+      exit 0
+    fi
+    echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] sweep value null; re-watching" >> "$LOG"
+  else
+    echo "[$ts] probe DEAD (rc=$rc): $line" >> "$LOG"
+  fi
+  sleep "$SLEEP"
+done

@@ -56,3 +56,21 @@ def test_peak_lookup():
     assert peak_flops_for("TPU v4") == 275e12
     assert peak_flops_for("cpu") is None
     assert np.isfinite(peak_flops_for("TPU v6 lite"))
+
+
+def test_bench_sweep_parse_is_forgiving():
+    """A malformed BENCH_SWEEP_ROWS env var must degrade to 'no sweep',
+    never raise: the parse runs at bench.py import time, before the
+    orchestrator's always-emit-JSON kill trap exists."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        pathlib.Path(__file__).resolve().parents[1] / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench._parse_sweep("64,128") == (64, 128)
+    assert bench._parse_sweep("") == ()
+    assert bench._parse_sweep("64;128") == ()          # wrong separator
+    assert bench._parse_sweep("64, oops,0,-3") == (64,)  # junk dropped

@@ -32,6 +32,12 @@ while true; do
         --out /root/repo/DETECT_BENCH_r05_tiny.json \
         >/root/repo/.bench_r05.detect_tiny 2>&1
       echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] tiny detect rc=$? (JSON written either way)" >> "$LOG"
+      # Full-model hardware soak: the end-to-end serving number (HTTP ->
+      # queue -> batched worker -> WS) on silicon, not just engine.run.
+      timeout 1800 python /root/repo/scripts/serve_soak.py --full --jobs 96 \
+        --out /root/repo/SERVE_SOAK_r05_tpu.json \
+        >/root/repo/.bench_r05.soak_tpu 2>&1
+      echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] full soak rc=$? (see SERVE_SOAK_r05_tpu.json)" >> "$LOG"
       exit 0
     fi
     echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] sweep value null; re-watching" >> "$LOG"

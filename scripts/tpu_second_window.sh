@@ -19,7 +19,12 @@ while true; do
   line=$(echo "$out" | tail -1 | head -c 160)
   if [ $rc -eq 0 ]; then
     echo "[$ts] probe OK: $line" >> "$LOG"
-    echo "[$ts] second window open: sweep bench..." >> "$LOG"
+    echo "[$ts] second window open: latency anatomy..." >> "$LOG"
+    timeout 600 python /root/repo/scripts/tpu_latency_anatomy.py \
+      --out /root/repo/LATENCY_ANATOMY_r05.json \
+      >/root/repo/.bench_r05.anatomy 2>&1
+    echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] anatomy rc=$? ($(tail -c 200 /root/repo/LATENCY_ANATOMY_r05.json 2>/dev/null))" >> "$LOG"
+    echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] sweep bench..." >> "$LOG"
     BENCH_SWEEP_ROWS=64,128 BENCH_WALL_BUDGET_S=2400 \
       timeout 2700 python /root/repo/bench.py \
       >/root/repo/.bench_r05_sweep.json 2>/root/repo/.bench_r05_sweep.stderr

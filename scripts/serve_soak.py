@@ -65,18 +65,14 @@ def _build_cfg(root: str, full: bool):
 def _make_features(root: str, dim: int, n: int = 4) -> str:
     import numpy as np
 
-    from vilbert_multitask_tpu.features.pipeline import RegionFeatures
+    from vilbert_multitask_tpu.features.pipeline import synthetic_regions
     from vilbert_multitask_tpu.features.store import save_reference_npy
 
     d = os.path.join(root, "features")
     os.makedirs(d, exist_ok=True)
     rng = np.random.default_rng(0)
     for i in range(n):
-        boxes = np.array([[10, 10, 60, 60], [30, 20, 90, 80],
-                          [5, 40, 50, 95]], np.float32)
-        region = RegionFeatures(
-            features=rng.normal(size=(3, dim)).astype(np.float32),
-            boxes=boxes, image_width=100, image_height=100)
+        region = synthetic_regions(dim, n_boxes=3, rng=rng)
         save_reference_npy(os.path.join(d, f"img_{i}.npy"), region,
                            f"img_{i}")
     return d

@@ -43,6 +43,13 @@ while true; do
         --out /root/repo/SERVE_SOAK_r05_tpu.json \
         >/root/repo/.bench_r05.soak_tpu 2>&1
       echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] full soak rc=$? (see SERVE_SOAK_r05_tpu.json)" >> "$LOG"
+      # Benchmark-protocol retrieval cost: captions/s vs a 100-image
+      # resident gallery on the full model (projects to Flickr30k IR).
+      timeout 1800 python /root/repo/scripts/tpu_gallery_bench.py \
+        --gallery 100 --captions 20 \
+        --out /root/repo/GALLERY_BENCH_r05.json \
+        >/root/repo/.bench_r05.gallery 2>&1
+      echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] gallery bench rc=$? (see GALLERY_BENCH_r05.json)" >> "$LOG"
       exit 0
     fi
     echo "[$(date -u +%Y-%m-%dT%H:%M:%S)] sweep value null; re-watching" >> "$LOG"

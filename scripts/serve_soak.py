@@ -155,12 +155,17 @@ def main(argv=None) -> int:
             "task_id": task_id, "socket_id": sock, "question": q,
             "image_list": [f"img_{k}.jpg" for k in range(n_img)],
         })
+        # Submit time is captured BEFORE the request goes out: e2e latency
+        # must include HTTP handling + durable-queue publish, and a fast
+        # worker could otherwise deliver the result frame before the stamp
+        # existed, yielding a negative latency sample (ADVICE r5).
+        t_submit = time.perf_counter()
         conn.request("POST", "/", body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         assert resp.status == 200, resp.read()
         resp.read()
-        submitted[q.lower()] = time.perf_counter()
+        submitted[q.lower()] = t_submit
 
     ok = done.wait(timeout=600)
     app.stop()

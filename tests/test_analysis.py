@@ -1,0 +1,539 @@
+"""vmtlint fixture suite: every rule proven to trigger AND to stay quiet.
+
+Each rule gets a positive snippet (the hazard, minimally) and a negative
+(the correct idiom it must not flag) — the negative matters as much as
+the positive: a lint that cries wolf gets disabled. Plus the suppression
+comment, baseline round-trip, config parsing, and CLI exit codes.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from vilbert_multitask_tpu.analysis import baseline as bl
+from vilbert_multitask_tpu.analysis.cli import main as cli_main
+from vilbert_multitask_tpu.analysis.config import parse_toml_tables
+from vilbert_multitask_tpu.analysis.core import analyze_source
+
+LIB = "vilbert_multitask_tpu/fake.py"  # library-rooted path for library_only
+
+
+def rules_hit(src: str, path: str = LIB):
+    return {f.rule for f in analyze_source(textwrap.dedent(src), path)}
+
+
+def findings(src: str, path: str = LIB):
+    return analyze_source(textwrap.dedent(src), path)
+
+
+# ----------------------------------------------------------------- VMT101
+def test_host_transfer_in_jit_triggers():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(np.asarray(x))
+    """
+    assert "VMT101" in rules_hit(src)
+
+
+def test_host_transfer_item_in_jit_wrapped_fn_triggers():
+    # The wrap-by-name form (jax.jit(g)) must mark g's body too.
+    src = """
+    import jax
+
+    def g(x):
+        return x.item()
+
+    run = jax.jit(g)
+    """
+    assert "VMT101" in rules_hit(src)
+
+
+def test_host_math_on_static_shapes_is_clean():
+    # The kernel idiom: shape dims are concrete Python ints under tracing,
+    # and static_argnames params are too — float(np.sqrt(D)) is fine.
+    src = """
+    import functools
+    import jax
+    import numpy as np
+
+    @functools.partial(jax.jit, static_argnames=("block",))
+    def f(x, block=8):
+        B, D = x.shape
+        scale = 1.0 / float(np.sqrt(D))
+        n = min(block, D)
+        return x * scale * n
+    """
+    assert "VMT101" not in rules_hit(src)
+
+
+def test_numpy_outside_jit_is_clean():
+    src = """
+    import numpy as np
+
+    def host_prep(x):
+        return np.asarray(x, np.float32)
+    """
+    assert "VMT101" not in rules_hit(src)
+
+
+# ----------------------------------------------------------------- VMT102
+def test_jit_in_loop_triggers():
+    src = """
+    import jax
+
+    def sweep(xs):
+        out = []
+        for x in xs:
+            out.append(jax.jit(lambda a: a + 1)(x))
+        return out
+    """
+    assert "VMT102" in rules_hit(src)
+
+
+def test_jit_hoisted_out_of_loop_is_clean():
+    src = """
+    import jax
+
+    def sweep(xs):
+        f = jax.jit(lambda a: a + 1)
+        return [f(x) for x in xs]
+    """
+    assert "VMT102" not in rules_hit(src)
+
+
+def test_unhashable_static_literal_triggers():
+    src = """
+    import jax
+
+    def g(x, sizes):
+        return x
+
+    f = jax.jit(g, static_argnums=(1,))
+
+    def call(x):
+        return f(x, [1, 2])
+    """
+    assert "VMT102" in rules_hit(src)
+
+
+def test_hashable_static_tuple_is_clean():
+    src = """
+    import jax
+
+    def g(x, sizes):
+        return x
+
+    f = jax.jit(g, static_argnums=(1,))
+
+    def call(x):
+        return f(x, (1, 2))
+    """
+    assert "VMT102" not in rules_hit(src)
+
+
+# ----------------------------------------------------------------- VMT103
+def test_donated_buffer_read_after_call_triggers():
+    src = """
+    import jax
+
+    def g(state):
+        return state
+
+    step = jax.jit(g, donate_argnums=(0,))
+
+    def train(state):
+        new = step(state)
+        return state.mean()
+    """
+    assert "VMT103" in rules_hit(src)
+
+
+def test_donation_without_rebind_in_loop_triggers():
+    src = """
+    import jax
+
+    def g(state):
+        return state
+
+    step = jax.jit(g, donate_argnums=(0,))
+
+    def train(state, n):
+        for _ in range(n):
+            loss = step(state)
+        return loss
+    """
+    assert "VMT103" in rules_hit(src)
+
+
+def test_donation_with_rebind_is_clean():
+    src = """
+    import jax
+
+    def g(state):
+        return state
+
+    step = jax.jit(g, donate_argnums=(0,))
+
+    def train(state, n):
+        for _ in range(n):
+            state = step(state)
+        return state
+    """
+    assert "VMT103" not in rules_hit(src)
+
+
+# ----------------------------------------------------------------- VMT104
+def test_unblocked_timed_dispatch_triggers():
+    src = """
+    import time
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        return time.perf_counter() - t0
+    """
+    assert "VMT104" in rules_hit(src)
+
+
+def test_blocked_timed_dispatch_is_clean():
+    src = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jax.block_until_ready(jnp.dot(x, x))
+        return time.perf_counter() - t0
+    """
+    assert "VMT104" not in rules_hit(src)
+
+
+def test_timed_host_only_span_is_clean():
+    # jax.devices()/config are blocking host calls — timing backend init
+    # is legitimate and must not be flagged.
+    src = """
+    import time
+    import jax
+
+    def boot():
+        t0 = time.perf_counter()
+        dev = jax.devices()[0]
+        return time.perf_counter() - t0
+    """
+    assert "VMT104" not in rules_hit(src)
+
+
+def test_submit_stamp_after_io_triggers():
+    # The exact serve_soak.py:148 bug shape (negative latency samples).
+    src = """
+    import time
+
+    def soak(conn, jobs, submitted):
+        for q in jobs:
+            conn.request("POST", "/", body=q)
+            resp = conn.getresponse()
+            submitted[q] = time.perf_counter()
+    """
+    assert "VMT104" in rules_hit(src)
+
+
+def test_submit_stamp_before_io_is_clean():
+    src = """
+    import time
+
+    def soak(conn, jobs, submitted):
+        for q in jobs:
+            t_submit = time.perf_counter()
+            conn.request("POST", "/", body=q)
+            resp = conn.getresponse()
+            submitted[q] = t_submit
+    """
+    assert "VMT104" not in rules_hit(src)
+
+
+# ----------------------------------------------------------------- VMT105
+def test_stray_print_in_library_triggers():
+    src = """
+    def helper(x):
+        print("debug", x)
+        return x
+    """
+    assert "VMT105" in rules_hit(src)
+
+
+def test_breakpoint_and_debug_print_trigger():
+    src = """
+    import jax
+
+    def helper(x):
+        breakpoint()
+        jax.debug.print("x={}", x)
+        return x
+    """
+    hits = [f for f in findings(src) if f.rule == "VMT105"]
+    assert len(hits) == 2
+
+
+def test_print_in_main_or_stderr_or_script_is_clean():
+    src = """
+    import sys
+
+    def helper(msg):
+        print(msg, file=sys.stderr)
+
+    def main():
+        print("usage: ...")
+
+    if __name__ == "__main__":
+        print("running")
+        main()
+    """
+    assert "VMT105" not in rules_hit(src)
+    # scripts are outside library_roots: even a bare print is exempt.
+    assert "VMT105" not in rules_hit(
+        "def helper():\n    print('x')\n", path="scripts/tool.py")
+
+
+# ----------------------------------------------------------------- VMT106
+def test_sqlite_conn_on_self_without_lock_triggers():
+    src = """
+    import sqlite3
+
+    class Store:
+        def __init__(self, path):
+            self.conn = sqlite3.connect(path)
+    """
+    assert "VMT106" in rules_hit(src)
+
+
+def test_check_same_thread_false_triggers():
+    src = """
+    import sqlite3
+
+    def open_db(path):
+        return sqlite3.connect(path, check_same_thread=False)
+    """
+    assert "VMT106" in rules_hit(src)
+
+
+def test_connection_per_call_and_locked_class_are_clean():
+    src = """
+    import sqlite3
+    import threading
+
+    class PerCall:
+        def _conn(self):
+            return sqlite3.connect("db.sqlite3", timeout=30.0)
+
+    class Locked:
+        def __init__(self, path):
+            self._lock = threading.Lock()
+            self.conn = sqlite3.connect(path)
+    """
+    assert "VMT106" not in rules_hit(src)
+
+
+# ----------------------------------------------------------------- VMT107
+def test_swallowed_exception_triggers():
+    src = """
+    def drain(q):
+        while True:
+            try:
+                q.pop()
+            except Exception:
+                continue
+    """
+    assert "VMT107" in rules_hit(src)
+
+
+def test_narrow_except_and_del_teardown_are_clean():
+    src = """
+    class F:
+        def read(self):
+            try:
+                return self._f.read()
+            except OSError:
+                pass
+
+        def __del__(self):
+            try:
+                self._f.close()
+            except Exception:
+                pass
+    """
+    assert "VMT107" not in rules_hit(src)
+
+
+# ----------------------------------------------------------------- VMT108
+def test_module_numpy_mutation_triggers():
+    src = """
+    import numpy as np
+
+    COUNTS = np.zeros(8)
+
+    def bump(i):
+        COUNTS[i] += 1
+    """
+    assert "VMT108" in rules_hit(src)
+
+
+def test_local_numpy_mutation_is_clean():
+    src = """
+    import numpy as np
+
+    def bump(i):
+        counts = np.zeros(8)
+        counts[i] += 1
+        return counts
+    """
+    assert "VMT108" not in rules_hit(src)
+
+
+# ----------------------------------------------- suppressions and baseline
+def test_inline_suppression_by_id_name_and_next_line():
+    base = """
+    def helper(x):
+        print("a")  # vmtlint: disable=VMT105
+        print("b")  # vmtlint: disable=stray-print
+        # vmtlint: disable-next-line=all
+        print("c")
+        print("d")
+    """
+    hits = [f for f in findings(base) if f.rule == "VMT105"]
+    assert len(hits) == 1 and hits[0].content.startswith('print("d")')
+
+
+def test_baseline_round_trip(tmp_path):
+    src = "def helper():\n    print('x')\n"
+    fs = analyze_source(src, LIB)
+    assert fs
+    path = str(tmp_path / "baseline.json")
+    bl.write_baseline(path, fs, justification="legacy diagnostic")
+    loaded = bl.load_baseline(path)
+    new, old, stale = bl.split_baselined(analyze_source(src, LIB), loaded)
+    assert not new and len(old) == len(fs) and not stale
+    # Editing the flagged line invalidates the entry: the finding comes
+    # back as new and the old entry reports stale.
+    edited = "def helper():\n    print('x', 'y')\n"
+    new2, old2, stale2 = bl.split_baselined(
+        analyze_source(edited, LIB), loaded)
+    assert new2 and not old2 and stale2
+
+
+def test_baseline_rejects_malformed(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"version": 99, "entries": []}))
+    with pytest.raises(ValueError):
+        bl.load_baseline(str(p))
+
+
+# ----------------------------------------------------------- config + CLI
+def test_toml_subset_parser():
+    text = textwrap.dedent("""
+    [project]
+    name = "x"  # comment
+
+    [tool.vmtlint]
+    paths = ["a", "b.py"]
+    exclude = [
+        "tests/fixtures",
+        "gen",
+    ]
+    baseline = "base.json"
+    fail_on = "warning"
+
+    [tool.vmtlint.severity]
+    VMT105 = "error"
+    """)
+    tables = parse_toml_tables(text)
+    lint = tables["tool.vmtlint"]
+    assert lint["paths"] == ["a", "b.py"]
+    assert lint["exclude"] == ["tests/fixtures", "gen"]
+    assert lint["baseline"] == "base.json"
+    assert tables["tool.vmtlint.severity"]["VMT105"] == "error"
+
+
+@pytest.fixture()
+def lint_repo(tmp_path, monkeypatch):
+    """A throwaway repo root: pyproject + one file per severity class."""
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+    [tool.vmtlint]
+    paths = ["pkg"]
+    library_roots = ["pkg"]
+    baseline = "baseline.json"
+    """))
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return np.asarray(x)
+
+    def helper(x):
+        print(x)
+    """))
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_cli_exit_codes_and_json(lint_repo, capsys):
+    assert cli_main([]) == 1  # error-severity finding present
+    out = capsys.readouterr().out
+    assert "VMT101" in out and "VMT105" in out
+
+    assert cli_main(["--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["counts"]["error"] == 1 and doc["counts"]["warning"] == 1
+
+    # Baseline everything -> clean non-strict AND strict runs.
+    assert cli_main(["--write-baseline", "baseline.json"]) == 0
+    capsys.readouterr()
+    assert cli_main([]) == 0
+    assert cli_main(["--strict"]) == 0
+
+    # Fix the error; its baseline entry is now stale: non-strict passes,
+    # strict demands the dead entry be removed.
+    (lint_repo / "pkg" / "bad.py").write_text(
+        "def helper(x):\n    print(x)  # vmtlint: disable=VMT105\n")
+    capsys.readouterr()
+    assert cli_main([]) == 0
+    assert cli_main(["--strict"]) == 1
+
+
+def test_cli_warning_only_gates_strict(tmp_path, monkeypatch, capsys):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.vmtlint]\npaths = [\"pkg\"]\nlibrary_roots = [\"pkg\"]\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "w.py").write_text("def h(x):\n    print(x)\n")
+    monkeypatch.chdir(tmp_path)
+    assert cli_main([]) == 0  # warnings don't fail the default gate
+    assert cli_main(["--strict"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_missing_path_is_usage_error(lint_repo, capsys):
+    assert cli_main(["no/such/dir"]) == 2
+    capsys.readouterr()
+
+
+def test_syntax_error_reports_vmt000(tmp_path, monkeypatch, capsys):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.vmtlint]\npaths = [\"pkg\"]\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "broken.py").write_text("def f(:\n")
+    monkeypatch.chdir(tmp_path)
+    assert cli_main([]) == 1
+    assert "VMT000" in capsys.readouterr().out

@@ -1,0 +1,55 @@
+"""Tier-1 gate: the repo's own code passes its own static analyzer.
+
+Runs vmtlint over the configured scan set (``[tool.vmtlint]`` in
+pyproject.toml: the library, bench.py, scripts/) and fails on any finding
+that is not grandfathered in vmtlint_baseline.json — so a PR that
+introduces a host transfer inside jit, a jit-in-loop recompile, a
+donated-buffer reuse, or an unblocked timed dispatch fails fast CI, not
+a TPU window. Pure AST work: no jax import, runs in well under a second.
+"""
+
+import os
+
+from vilbert_multitask_tpu.analysis import baseline as bl
+from vilbert_multitask_tpu.analysis.config import load_config
+from vilbert_multitask_tpu.analysis.core import analyze_paths
+from vilbert_multitask_tpu.analysis.rules import default_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scan():
+    cfg, root = load_config(REPO_ROOT)
+    assert root == REPO_ROOT, "pyproject.toml with [tool.vmtlint] not found"
+    paths = [os.path.join(root, p) for p in cfg.paths]
+    findings = analyze_paths(paths, root=root,
+                             rules=default_rules(cfg.severity),
+                             exclude=cfg.exclude,
+                             library_roots=cfg.library_roots)
+    baseline = {}
+    if cfg.baseline:
+        baseline = bl.load_baseline(os.path.join(root, cfg.baseline))
+    return bl.split_baselined(findings, baseline), baseline
+
+
+def test_repo_has_no_unbaselined_findings():
+    (new, _baselined, _stale), _ = _scan()
+    assert not new, "vmtlint findings (fix or baseline with justification):\n" \
+        + "\n".join(f"  {f.path}:{f.line}: {f.rule} {f.message}"
+                    for f in new)
+
+
+def test_baseline_has_no_stale_entries():
+    # Debt that got paid must leave the ledger: a fixed finding's entry is
+    # dead weight that would mask a regression at the same fingerprint.
+    (_new, _baselined, stale), baseline = _scan()
+    assert not stale, "stale baseline entries (remove from " \
+        "vmtlint_baseline.json):\n" + "\n".join(
+            f"  {fp} ({baseline[fp].get('path')})" for fp in stale)
+
+
+def test_baseline_entries_carry_justification():
+    _, baseline = _scan()
+    missing = [fp for fp, e in baseline.items()
+               if not str(e.get("justification", "")).strip()]
+    assert not missing, f"baseline entries lack a justification: {missing}"

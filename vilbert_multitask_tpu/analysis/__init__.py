@@ -1,0 +1,28 @@
+"""vmtlint: JAX-aware static analysis for this repo's real failure modes.
+
+The worst perf bug in this repo's history — host-numpy params silently
+re-transferred ~1GB per forward (23.7 s p50, round 2) — was invisible to
+unit tests but statically visible in the AST. This package is the scalable
+defense: an AST lint pass with a rule registry targeting host-transfer,
+recompile, donation, sqlite-threading, and bench-timing hazards, wired
+into tier-1 via ``tests/test_repo_clean.py``.
+
+CLI::
+
+    python -m vilbert_multitask_tpu.analysis [--strict] [--baseline FILE]
+        [--write-baseline FILE] [--json] [paths...]
+
+Suppress a finding inline with ``# vmtlint: disable=VMT101`` (rule id or
+rule name; ``disable=all`` silences the line). Grandfathered findings live
+in the baseline file (default from ``[tool.vmtlint]`` in pyproject.toml),
+each entry carrying a one-line justification.
+"""
+
+from vilbert_multitask_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+from vilbert_multitask_tpu.analysis.rules import RULES  # noqa: F401

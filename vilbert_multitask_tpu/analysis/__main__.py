@@ -1,0 +1,5 @@
+import sys
+
+from vilbert_multitask_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,78 @@
+"""Baseline file I/O: grandfathered findings carried with justifications.
+
+The baseline lets the analyzer land strict on an existing tree: every
+pre-existing finding either gets fixed or gets a baseline entry with a
+one-line justification. Entries match on fingerprint (rule + path +
+content hash — line-number free, so pure line shifts don't invalidate
+them; editing the flagged line does, forcing a re-review).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from vilbert_multitask_tpu.analysis.core import Finding
+
+VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """{fingerprint: entry}; raises ValueError on a malformed file (a
+    silently-ignored baseline would un-grandfather everything at once)."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != VERSION:
+        raise ValueError(f"{path}: not a vmtlint baseline "
+                         f"(want version={VERSION})")
+    entries = data.get("entries", [])
+    out: Dict[str, dict] = {}
+    for e in entries:
+        if not isinstance(e, dict) or "fingerprint" not in e:
+            raise ValueError(f"{path}: baseline entry missing fingerprint: "
+                             f"{e!r}")
+        out[e["fingerprint"]] = e
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   justification: str = "grandfathered at baseline "
+                   "creation; fix on next touch") -> None:
+    entries = []
+    seen = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        fp = f.fingerprint()
+        if fp in seen:  # identical line elsewhere in the file: one entry
+            continue
+        seen.add(fp)
+        entries.append({
+            "fingerprint": fp,
+            "rule": f.rule,
+            "name": f.name,
+            "path": f.path,
+            "line": f.line,  # informational; matching ignores it
+            "content": f.content,
+            "justification": justification,
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": VERSION, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def split_baselined(findings: Sequence[Finding], baseline: Dict[str, dict]
+                    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, baselined, stale_fingerprints). Stale entries — baseline rows
+    whose finding no longer exists — are reported so the file shrinks as
+    debt is paid instead of accreting dead rows."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    hit = set()
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in baseline:
+            hit.add(fp)
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - hit)
+    return new, old, stale

@@ -1,0 +1,117 @@
+"""vmtlint CLI: ``python -m vilbert_multitask_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (new findings only at severities below the gate),
+1 findings at/above the gate (``error`` by default, everything with
+``--strict``), 2 usage/config errors. Stale baseline entries fail a
+``--strict`` run so the baseline file shrinks as debt is paid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from vilbert_multitask_tpu.analysis import baseline as bl
+from vilbert_multitask_tpu.analysis import report
+from vilbert_multitask_tpu.analysis.config import load_config
+from vilbert_multitask_tpu.analysis.core import analyze_paths, iter_python_files
+from vilbert_multitask_tpu.analysis.rules import RULES, default_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m vilbert_multitask_tpu.analysis",
+        description="JAX-aware static analysis for this repo's failure "
+                    "modes (host transfers in jit, recompile triggers, "
+                    "donation reuse, bench-timing hazards, ...)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: [tool.vmtlint] paths)")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on warnings and stale baseline entries too")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file of grandfathered findings "
+                        "(default: [tool.vmtlint] baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any configured baseline")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write current findings as a new baseline and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON document instead of human lines")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for cls in RULES:
+            print(f"{cls.id}  {cls.name:24s} [{cls.severity}] "
+                  f"{cls.description}")
+        return 0
+
+    cfg, root = load_config(os.getcwd())
+    root = root or os.getcwd()
+    paths = list(args.paths) or [
+        p if os.path.isabs(p) else os.path.join(root, p) for p in cfg.paths]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"vmtlint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    rules = default_rules(cfg.severity)
+    findings = analyze_paths(paths, root=root, rules=rules,
+                             exclude=cfg.exclude,
+                             library_roots=cfg.library_roots)
+    scanned = {
+        os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+        for p in iter_python_files(paths, exclude=cfg.exclude)}
+    files_scanned = len(scanned)
+
+    if args.write_baseline:
+        bl.write_baseline(args.write_baseline, findings)
+        print(f"vmtlint: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}; add a one-line justification to "
+              f"each entry", file=sys.stderr)
+        return 0
+
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or (
+            os.path.join(root, cfg.baseline) if cfg.baseline else None)
+    baseline = {}
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            baseline = bl.load_baseline(baseline_path)
+        except (ValueError, OSError) as e:
+            print(f"vmtlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+    elif args.baseline:  # explicitly requested but absent → usage error
+        print(f"vmtlint: baseline not found: {args.baseline}",
+              file=sys.stderr)
+        return 2
+
+    new, baselined, stale = bl.split_baselined(findings, baseline)
+    # Stale = "the grandfathered finding is gone" — only judgeable for
+    # files this run actually scanned; a subset scan must not condemn
+    # entries for files outside it.
+    stale = [fp for fp in stale
+             if baseline[fp].get("path") in scanned]
+    render = report.render_json if args.as_json else report.render_human
+    out = render(new, baselined, stale, files_scanned)
+    if out:
+        print(out)
+
+    gate: List = [f for f in new if f.severity == "error"]
+    if args.strict or cfg.fail_on == "warning":
+        gate = list(new)
+        if stale and args.strict:
+            return 1
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

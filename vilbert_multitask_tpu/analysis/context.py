@@ -1,0 +1,299 @@
+"""Per-module analysis context: alias resolution and jit-boundary discovery.
+
+Rules never look at raw names — ``import numpy as np``, ``from jax import
+jit``, ``from functools import partial`` all normalize through
+:meth:`ModuleContext.resolve` to canonical dotted paths ("numpy.asarray",
+"jax.jit", ...), so a rule matches the *binding*, not the spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+JIT_ENTRYPOINTS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+
+JitBody = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """One jit-compiled scope plus the staticness facts rules need."""
+
+    body: JitBody
+    donate: Tuple[int, ...] = ()
+    # Parameter names that are static under this jit (static_argnames, or
+    # positions from static_argnums mapped onto the signature): host math
+    # on them is trace-time constant, not a per-call transfer.
+    static_params: Tuple[str, ...] = ()
+
+
+class ModuleContext:
+    """One parsed module plus the lookup tables every rule shares."""
+
+    def __init__(self, rel_path: str, source: str, tree: ast.Module):
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.aliases: Dict[str, str] = {}
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        self._collect_aliases()
+        # Every jit-compiled scope: decorated defs, defs wrapped by name,
+        # lambdas passed inline.
+        self.jit_bodies: List[JitInfo] = []
+        # Local names bound to a jitted callable (``f = jax.jit(g, ...)``),
+        # mapped to their donate_argnums (empty tuple = jitted, no donation).
+        self.jit_bound_names: Dict[str, Tuple[int, ...]] = {}
+        self._collect_jit_bodies()
+
+    # ------------------------------------------------------------- aliases
+    def _collect_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def resolve(self, node: ast.AST) -> str:
+        """Canonical dotted path for a Name/Attribute chain ("" if not one)."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else ""
+        return ""
+
+    # --------------------------------------------------------------- tree
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def in_loop(self, node: ast.AST, *, stop_at_function: bool = True
+                ) -> bool:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While)):
+                return True
+            if stop_at_function and isinstance(
+                    anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+        return False
+
+    def in_main_block(self, node: ast.AST) -> bool:
+        """True under ``if __name__ == "__main__":`` or inside a function
+        named like a CLI entrypoint (main / _main / cli*) — script-style
+        code where prints are the user interface, not debris."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = anc.name.lstrip("_")
+                if name == "main" or name.startswith("cli"):
+                    return True
+            if isinstance(anc, ast.If) and _is_main_guard(anc.test):
+                return True
+        return False
+
+    # ---------------------------------------------------------------- jit
+    def is_jit_entry(self, node: ast.AST) -> bool:
+        """Does this expression evaluate to jax.jit/pjit (directly or via
+        ``functools.partial(jax.jit, ...)``)?"""
+        if self.resolve(node) in JIT_ENTRYPOINTS:
+            return True
+        return (isinstance(node, ast.Call)
+                and self.resolve(node.func) == "functools.partial"
+                and bool(node.args)
+                and self.resolve(node.args[0]) in JIT_ENTRYPOINTS)
+
+    def _jit_kwargs(self, call: ast.Call) -> List[ast.keyword]:
+        """Keywords of a jit(...) or partial(jit, ...)(...) call, with the
+        partial's own kwargs merged in."""
+        kwargs = list(call.keywords)
+        inner = call.func
+        if isinstance(inner, ast.Call):
+            # partial(jax.jit, static_argnames=...)(fn) nests the jit
+            # kwargs one call deeper; merge both levels.
+            kwargs = list(inner.keywords) + kwargs
+        return kwargs
+
+    def _donate_of(self, call: ast.Call) -> Tuple[int, ...]:
+        """Literal donate_argnums of a jit(...) or partial(jit, ...) call."""
+        for kw in self._jit_kwargs(call):
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                return _literal_int_tuple(kw.value)
+        return ()
+
+    def _static_params_of(self, call: Optional[ast.Call], body: JitBody
+                          ) -> Tuple[str, ...]:
+        """Parameter names static under this jit: static_argnames verbatim,
+        static_argnums mapped through the signature."""
+        if call is None:
+            return ()
+        names: List[str] = []
+        params = [a.arg for a in body.args.args] if not isinstance(
+            body, ast.Lambda) else [a.arg for a in body.args.args]
+        for kw in self._jit_kwargs(call):
+            if kw.arg == "static_argnames":
+                names.extend(_literal_str_tuple(kw.value))
+            elif kw.arg == "static_argnums":
+                names.extend(params[i] for i in _literal_int_tuple(kw.value)
+                             if i < len(params))
+        return tuple(names)
+
+    def _collect_jit_bodies(self) -> None:
+        defs: Dict[str, List[JitBody]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                for deco in node.decorator_list:
+                    if self.is_jit_entry(deco):
+                        call = deco if isinstance(deco, ast.Call) else None
+                        self.jit_bodies.append(JitInfo(
+                            node,
+                            self._donate_of(call) if call else (),
+                            self._static_params_of(call, node)))
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and self.is_jit_entry(node.func) and node.args):
+                continue
+            target, donate = node.args[0], self._donate_of(node)
+            if isinstance(target, ast.Lambda):
+                self.jit_bodies.append(JitInfo(
+                    target, donate, self._static_params_of(node, target)))
+            elif isinstance(target, ast.Name):
+                for d in defs.get(target.id, []):
+                    self.jit_bodies.append(JitInfo(
+                        d, donate, self._static_params_of(node, d)))
+            # f = jax.jit(g, ...): record the bound name for call-site rules.
+            parent = self.parent(node)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        self.jit_bound_names[t.id] = donate
+        # Jit-decorated defs are themselves callable-by-name.
+        for info in self.jit_bodies:
+            if isinstance(info.body,
+                          (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.jit_bound_names.setdefault(info.body.name, info.donate)
+
+    def jitted_call_name(self, call: ast.Call) -> Optional[str]:
+        """If ``call`` invokes a known-jitted local binding, its name."""
+        if (isinstance(call.func, ast.Name)
+                and call.func.id in self.jit_bound_names):
+            return call.func.id
+        return None
+
+
+def _is_main_guard(test: ast.AST) -> bool:
+    if not (isinstance(test, ast.Compare) and len(test.comparators) == 1):
+        return False
+    left, right = test.left, test.comparators[0]
+    names = {n.id for n in (left, right) if isinstance(n, ast.Name)}
+    consts = {c.value for c in (left, right) if isinstance(c, ast.Constant)}
+    return "__name__" in names and "__main__" in consts
+
+
+def _literal_int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    return ()
+
+
+def _literal_str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(el.value for el in node.elts
+                     if isinstance(el, ast.Constant)
+                     and isinstance(el.value, str))
+    return ()
+
+
+def static_names_in(info: JitInfo) -> Set[str]:
+    """Names that hold trace-time-static Python values inside a jit body:
+    the jit's static params plus anything derived from ``.shape`` (shapes
+    are concrete ints under tracing — host math on them is free and
+    common in kernel code: ``B, H, N, D = q.shape``)."""
+    static: Set[str] = set(info.static_params)
+    stmts = (info.body.body if isinstance(info.body.body, list)
+             else [info.body.body])
+    changed = True
+    while changed:  # fixed point: statics derived from statics
+        changed = False
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _is_static_expr(node.value, static):
+                    continue
+                for t in node.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for el in elts:
+                        if isinstance(el, ast.Name) and el.id not in static:
+                            static.add(el.id)
+                            changed = True
+    return static
+
+
+def _is_static_expr(node: ast.AST, static: Set[str]) -> bool:
+    """Expression built only from literals, static names, and ``.shape``
+    access — i.e. a compile-time Python value under tracing."""
+    if is_literal(node):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in static
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size", "dtype")
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value, static)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand, static)
+    if isinstance(node, ast.BinOp):
+        return (_is_static_expr(node.left, static)
+                and _is_static_expr(node.right, static))
+    if isinstance(node, ast.Call):
+        # len(x) / min(a, b) / np.sqrt(D)-style host math over statics.
+        return all(_is_static_expr(a, static) for a in node.args)
+    return False
+
+
+def is_literal(node: ast.AST) -> bool:
+    """Constant-foldable expression (safe to call numpy on inside a trace —
+    it produces a compile-time constant, not a per-call host transfer)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(is_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return is_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return is_literal(node.left) and is_literal(node.right)
+    return False

@@ -1,0 +1,177 @@
+"""Finding/Rule model, inline suppressions, and the file-walking driver."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str  # "VMT101"
+    name: str  # "host-transfer-in-jit"
+    severity: str  # error | warning
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    content: str = ""  # stripped source line — the baseline fingerprint key
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity: surviving a pure line shift must not
+        invalidate a baseline entry; editing the flagged line must."""
+        digest = hashlib.sha1(self.content.encode("utf-8")).hexdigest()[:12]
+        return f"{self.rule}:{self.path}:{digest}"
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+
+class Rule:
+    """One registered check. Subclasses set the class attrs and implement
+    :meth:`check`; severity may be overridden per-repo via config."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+    # Rel-path prefixes this rule is restricted to ("" = everywhere).
+    # e.g. the stray-print rule only polices library code, not scripts.
+    library_only: bool = False
+
+    def __init__(self, severity: Optional[str] = None):
+        if severity is not None:
+            self.severity = severity
+
+    def applies_to(self, ctx: ModuleContext, library_roots: Sequence[str]
+                   ) -> bool:
+        if not self.library_only:
+            return True
+        return any(ctx.rel_path.startswith(root.rstrip("/") + "/")
+                   for root in library_roots)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST, message: str
+                ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        content = (ctx.lines[line - 1].strip()
+                   if 0 < line <= len(ctx.lines) else "")
+        return Finding(rule=self.id, name=self.name, severity=self.severity,
+                       path=ctx.rel_path, line=line,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message, content=content)
+
+
+# ------------------------------------------------------------ suppressions
+_SUPPRESS_RE = re.compile(
+    r"#\s*vmtlint:\s*(disable|disable-next-line)\s*=\s*"
+    r"([A-Za-z0-9_,\-\s]+)")
+
+
+def suppressions_for(source: str) -> Dict[int, Set[str]]:
+    """{line_number: {rule ids/names/'all'}} from inline comments."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        target = i + 1 if m.group(1) == "disable-next-line" else i
+        rules = {r.strip().lower() for r in m.group(2).split(",") if r.strip()}
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def is_suppressed(finding: Finding, suppressions: Dict[int, Set[str]]
+                  ) -> bool:
+    rules = suppressions.get(finding.line)
+    if not rules:
+        return False
+    return bool(rules & {"all", finding.rule.lower(), finding.name.lower()})
+
+
+# ----------------------------------------------------------------- driver
+def analyze_source(source: str, rel_path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None,
+                   library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
+                   ) -> List[Finding]:
+    """Analyze one module's source. Returns unsuppressed findings sorted by
+    (path, line, rule). Syntax errors yield a single VMT000 error — an
+    unparseable file must fail loudly, not pass silently."""
+    if rules is None:
+        from vilbert_multitask_tpu.analysis.rules import default_rules
+
+        rules = default_rules()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="VMT000", name="syntax-error", severity="error",
+                        path=rel_path, line=e.lineno or 1, col=e.offset or 1,
+                        message=f"file does not parse: {e.msg}",
+                        content=(e.text or "").strip())]
+    ctx = ModuleContext(rel_path, source, tree)
+    sup = suppressions_for(source)
+    findings = [
+        f for rule in rules if rule.applies_to(ctx, library_roots)
+        for f in rule.check(ctx) if not is_suppressed(f, sup)
+    ]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_file(path: str, root: str = ".",
+                 rules: Optional[Sequence[Rule]] = None,
+                 library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
+                 ) -> List[Finding]:
+    rel = os.path.relpath(os.path.abspath(path),
+                          os.path.abspath(root)).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return analyze_source(source, rel, rules=rules,
+                          library_roots=library_roots)
+
+
+def iter_python_files(paths: Iterable[str],
+                      exclude: Sequence[str] = ()) -> Iterator[str]:
+    """Expand files/dirs to .py files, skipping excluded path fragments."""
+
+    def excluded(p: str) -> bool:
+        norm = p.replace(os.sep, "/")
+        return any(pat in norm for pat in exclude)
+
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py") and not excluded(p):
+                yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__"
+                    and not excluded(os.path.join(dirpath, d)))
+                for fn in sorted(filenames):
+                    full = os.path.join(dirpath, fn)
+                    if fn.endswith(".py") and not excluded(full):
+                        yield full
+
+
+def analyze_paths(paths: Sequence[str], root: str = ".",
+                  rules: Optional[Sequence[Rule]] = None,
+                  exclude: Sequence[str] = (),
+                  library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
+                  ) -> List[Finding]:
+    out: List[Finding] = []
+    for path in iter_python_files(paths, exclude=exclude):
+        out.extend(analyze_file(path, root=root, rules=rules,
+                                library_roots=library_roots))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,47 @@
+"""Finding reporters: human-readable lines and a machine JSON document."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from vilbert_multitask_tpu.analysis.core import Finding
+
+
+def _counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.severity] = out.get(f.severity, 0) + 1
+    return out
+
+
+def render_human(new: Sequence[Finding], baselined: Sequence[Finding],
+                 stale: Sequence[str], files_scanned: int) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}[{f.severity}] "
+                     f"{f.message} ({f.name})")
+    counts = _counts(new)
+    summary = (f"vmtlint: {len(new)} finding(s) "
+               f"({counts.get('error', 0)} error, "
+               f"{counts.get('warning', 0)} warning) "
+               f"in {files_scanned} file(s)")
+    if baselined:
+        summary += f"; {len(baselined)} baselined"
+    if stale:
+        summary += f"; {len(stale)} stale baseline entr(y/ies)"
+        for fp in stale:
+            lines.append(f"stale baseline entry (fixed? remove it): {fp}")
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(new: Sequence[Finding], baselined: Sequence[Finding],
+                stale: Sequence[str], files_scanned: int) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in new],
+        "baselined": [f.to_json() for f in baselined],
+        "stale_baseline_entries": list(stale),
+        "counts": _counts(new),
+        "files_scanned": files_scanned,
+    }, indent=2)

@@ -1,0 +1,587 @@
+"""The vmtlint ruleset: this codebase's real failure modes, as AST checks.
+
+Every rule here traces back to a measured incident or advisor finding:
+VMT101 is the round-2 1GB-per-forward host transfer, VMT104 is the
+`serve_soak.py` negative-latency timestamp bug, VMT107 is the silent
+worker-loop swallow class, etc. Rules are deliberately narrow — a lint
+that cries wolf gets disabled; one that encodes the repo's actual
+post-mortems gets kept.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from vilbert_multitask_tpu.analysis.context import (
+    ModuleContext,
+    _is_static_expr,
+    _literal_int_tuple,
+    is_literal,
+    static_names_in,
+)
+from vilbert_multitask_tpu.analysis.core import Finding, Rule
+
+# --------------------------------------------------------------------- 101
+HOST_TRANSFER_CALLS = {
+    "jax.device_get": "fetches device buffers to host",
+    "numpy.asarray": "materializes a host array from a traced value",
+    "numpy.array": "materializes a host array from a traced value",
+}
+HOST_TRANSFER_METHODS = {"item", "tolist"}
+HOST_SCALAR_BUILTINS = {"float", "int", "bool"}
+
+
+class HostTransferInJit(Rule):
+    """np.*/.item()/float()/device_get reachable inside a jit boundary.
+
+    Inside a traced function these either fail at trace time or — worse —
+    silently execute per call on concrete inputs, re-shipping host bytes
+    every forward (the round-2 23.7 s p50). numpy calls whose args are all
+    literals are allowed: they fold to compile-time constants.
+    """
+
+    id = "VMT101"
+    name = "host-transfer-in-jit"
+    severity = "error"
+    description = ("host-transfer call (np.asarray/np.array/.item()/"
+                   ".tolist()/float()/jax.device_get) inside a "
+                   "jit/pjit-compiled function")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        seen: Set[int] = set()
+        for info in ctx.jit_bodies:
+            body = info.body
+            # Trace-time-static names (static_argnames/nums params, shape
+            # tuple unpacks): host math on them is a compile-time constant
+            # — the kernel idiom ``scale=1/float(np.sqrt(D))`` is fine.
+            static = static_names_in(info)
+            scope = body.body if isinstance(body.body, list) else [body.body]
+            for stmt in scope:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    seen.add(id(node))
+                    f = self._check_call(ctx, node, static)
+                    if f is not None:
+                        yield f
+
+    def _check_call(self, ctx: ModuleContext, call: ast.Call,
+                    static: Set[str]) -> Optional[Finding]:
+        resolved = ctx.resolve(call.func)
+        args_static = all(_is_static_expr(a, static) for a in call.args)
+        if resolved in HOST_TRANSFER_CALLS:
+            return self.finding(
+                ctx, call, f"`{resolved}` inside a jitted function "
+                f"{HOST_TRANSFER_CALLS[resolved]} — every call pays a "
+                f"device→host→device round trip; use jnp or hoist out of "
+                f"the jit boundary")
+        if resolved.startswith("numpy.") and not args_static:
+            return self.finding(
+                ctx, call, f"`{resolved}` on a non-static value inside a "
+                f"jitted function runs on host per call (tracer leak or "
+                f"silent host transfer); use the jax.numpy equivalent")
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in HOST_TRANSFER_METHODS):
+            return self.finding(
+                ctx, call, f"`.{call.func.attr}()` inside a jitted function "
+                f"forces a host transfer per call; return the array and "
+                f"convert outside the jit")
+        if (isinstance(call.func, ast.Name)
+                and call.func.id in HOST_SCALAR_BUILTINS
+                and call.args and not args_static):
+            return self.finding(
+                ctx, call, f"`{call.func.id}()` on a traced value inside a "
+                f"jitted function forces a concrete host scalar "
+                f"(ConcretizationError at best, per-call sync at worst)")
+        return None
+
+
+# --------------------------------------------------------------------- 102
+class RecompileTrigger(Rule):
+    """jit cache defeats: a fresh jitted callable per loop iteration, or an
+    unhashable literal passed as a static argument.
+
+    ``jax.jit(f)`` keys its compile cache on the wrapped callable's
+    identity — building it inside a loop recompiles every iteration.
+    A list/dict/set passed for a ``static_argnums`` slot raises
+    "unhashable static arguments" at call time.
+    """
+
+    id = "VMT102"
+    name = "recompile-trigger"
+    severity = "error"
+    description = ("jax.jit created inside a loop, or an unhashable "
+                   "literal passed as a static argument")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call) and ctx.is_jit_entry(node.func)
+                    and ctx.in_loop(node, stop_at_function=False)):
+                yield self.finding(
+                    ctx, node, "jax.jit inside a loop builds a fresh "
+                    "callable each iteration — the compile cache keys on "
+                    "callable identity, so every iteration recompiles; "
+                    "hoist the jitted function out of the loop")
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if (ctx.is_jit_entry(deco)
+                            and ctx.in_loop(node, stop_at_function=False)):
+                        yield self.finding(
+                            ctx, node, f"jit-decorated `{node.name}` is "
+                            f"defined inside a loop — each iteration "
+                            f"creates and compiles a new callable")
+        yield from self._unhashable_statics(ctx)
+
+    def _unhashable_statics(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # static positions per locally-jitted name, from the jit call site.
+        static_pos: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.is_jit_entry(node.func)):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "static_argnums":
+                    continue
+                pos = _literal_int_tuple(kw.value)
+                parent = ctx.parent(node)
+                if pos and isinstance(parent, ast.Assign):
+                    for t in parent.targets:
+                        if isinstance(t, ast.Name):
+                            static_pos[t.id] = pos
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_pos):
+                continue
+            for i in static_pos[node.func.id]:
+                if i < len(node.args) and isinstance(
+                        node.args[i], (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        ctx, node.args[i],
+                        f"unhashable literal passed to static arg {i} of "
+                        f"jitted `{node.func.id}` — static argument values "
+                        f"must be hashable (use a tuple)")
+
+
+# --------------------------------------------------------------------- 103
+class DonatedBufferReuse(Rule):
+    """Reading a buffer after passing it to a donate_argnums call.
+
+    Donation hands the input's device memory to XLA for the output; the
+    Python reference still exists but the buffer is deleted — touching it
+    raises, or on some backends silently reads garbage. The common shape:
+    ``loss = step(state, batch)`` in a loop without rebinding ``state``.
+    """
+
+    id = "VMT103"
+    name = "donated-buffer-reuse"
+    severity = "error"
+    description = ("variable used again after being passed in a "
+                   "donate_argnums position")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_block(ctx, node.body)
+
+    def _donating_calls(self, ctx: ModuleContext, stmt: ast.stmt
+                        ) -> Iterator[Tuple[ast.Call, List[str]]]:
+        for node in ast.walk(stmt):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            donate = ctx.jit_bound_names.get(node.func.id)
+            if not donate:
+                continue
+            names = [node.args[i].id for i in donate
+                     if i < len(node.args)
+                     and isinstance(node.args[i], ast.Name)]
+            if names:
+                yield node, names
+
+    @staticmethod
+    def _bound_names(stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                out.add(node.id)
+        return out
+
+    def _check_block(self, ctx: ModuleContext, block: List[ast.stmt]
+                     ) -> Iterator[Finding]:
+        donated: Dict[str, int] = {}  # name -> line it was donated on
+        for stmt in block:
+            # Reads happen before this statement's own (re)bindings.
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in donated):
+                    yield self.finding(
+                        ctx, node, f"`{node.id}` was donated to a "
+                        f"donate_argnums call on line {donated[node.id]}; "
+                        f"its device buffer no longer exists — rebind the "
+                        f"result or drop the donation")
+                    donated.pop(node.id)
+            for call, names in self._donating_calls(ctx, stmt):
+                for n in names:
+                    donated[n] = call.lineno
+            for n in self._bound_names(stmt):
+                donated.pop(n, None)
+            # Loop bodies: a donation inside whose name is never rebound in
+            # the body is read again by the call itself next iteration.
+            if isinstance(stmt, (ast.For, ast.While)):
+                rebound = set()
+                for inner in stmt.body:
+                    rebound |= self._bound_names(inner)
+                for inner in stmt.body:
+                    for call, names in self._donating_calls(ctx, inner):
+                        for n in names:
+                            if n not in rebound:
+                                yield self.finding(
+                                    ctx, call, f"`{n}` is donated inside "
+                                    f"this loop but never rebound in the "
+                                    f"loop body — the next iteration reads "
+                                    f"a deleted buffer; assign the call's "
+                                    f"result back to `{n}`")
+
+
+# --------------------------------------------------------------------- 104
+BLOCKING_CALLS = {"jax.block_until_ready", "jax.device_get",
+                  "jax.effects_barrier"}
+# Calls that enqueue async device work. Deliberately a list, not "jax.*":
+# jax.devices()/default_backend()/config.update() etc. are host-side and
+# blocking — flagging a timed backend-init span would be a false positive.
+_DISPATCH_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.",
+                      "jax.scipy.")
+_DISPATCH_CALLS = {"jax.device_put"}
+_SUBMIT_NAME_RE = re.compile(r"(submit|start|begin|t_?0|sent)", re.I)
+_IO_METHODS = {"getresponse", "recv", "urlopen", "readinto"}
+
+
+class BenchTimingHazard(Rule):
+    """Timing spans that measure the wrong thing.
+
+    (a) a ``time.perf_counter()`` span around async JAX dispatches with no
+    ``block_until_ready``/``device_get`` inside the measured region times
+    only the dispatch, not the work; (b) a submit/start timestamp captured
+    *after* the blocking I/O it claims to measure — the exact
+    ``serve_soak.py:148`` bug that produced negative latency samples.
+    """
+
+    id = "VMT104"
+    name = "bench-timing-hazard"
+    severity = "error"
+    description = ("perf_counter span around device dispatches without "
+                   "block_until_ready, or a submit timestamp captured "
+                   "after the measured I/O")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_spans(ctx, node.body)
+            if isinstance(node, (ast.For, ast.While)):
+                yield from self._check_spans(ctx, node.body)
+                yield from self._late_submit_stamp(ctx, node.body)
+
+    # -- (a) unblocked device span ---------------------------------------
+    def _is_perf_counter(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and ctx.resolve(node.func) in
+                ("time.perf_counter", "time.monotonic", "time.time"))
+
+    def _span_ends(self, ctx: ModuleContext, stmt: ast.stmt
+                   ) -> Set[str]:
+        """Names t for which this statement computes ``perf_counter() - t``."""
+        out: Set[str] = set()
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and self._is_perf_counter(ctx, node.left)
+                    and isinstance(node.right, ast.Name)):
+                out.add(node.right.id)
+        return out
+
+    def _device_dispatch(self, ctx: ModuleContext, stmt: ast.stmt
+                         ) -> Optional[ast.Call]:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if (resolved.startswith(_DISPATCH_PREFIXES)
+                    or resolved in _DISPATCH_CALLS
+                    or ctx.jitted_call_name(node)):
+                return node
+        return None
+
+    def _has_blocker(self, ctx: ModuleContext, stmt: ast.stmt) -> bool:
+        return any(isinstance(n, ast.Call)
+                   and ctx.resolve(n.func) in BLOCKING_CALLS
+                   for n in ast.walk(stmt))
+
+    def _check_spans(self, ctx: ModuleContext, block: List[ast.stmt]
+                     ) -> Iterator[Finding]:
+        open_spans: Dict[str, int] = {}  # timer var -> stmt index
+        for i, stmt in enumerate(block):
+            for t in self._span_ends(ctx, stmt):
+                if t not in open_spans:
+                    continue
+                span = block[open_spans.pop(t):i]
+                dispatch = next(
+                    (d for s in span
+                     if (d := self._device_dispatch(ctx, s)) is not None),
+                    None)
+                if dispatch is not None and not any(
+                        self._has_blocker(ctx, s) for s in span):
+                    yield self.finding(
+                        ctx, dispatch, "timed region dispatches JAX work "
+                        "but never blocks on it — jax dispatch is async, "
+                        "so the span measures launch overhead, not "
+                        "compute; add jax.block_until_ready(...) inside "
+                        "the measured region")
+            if (isinstance(stmt, ast.Assign)
+                    and self._is_perf_counter(ctx, stmt.value)):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        open_spans[target.id] = i
+
+    # -- (b) submit stamp after the measured I/O -------------------------
+    def _late_submit_stamp(self, ctx: ModuleContext, block: List[ast.stmt]
+                           ) -> Iterator[Finding]:
+        io_seen = False
+        for stmt in block:
+            if not io_seen:
+                io_seen = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _IO_METHODS
+                    for n in ast.walk(stmt))
+                continue
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for node in ast.walk(stmt):
+                if not self._is_perf_counter(ctx, node):
+                    continue
+                for target in stmt.targets:
+                    base = target
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if (isinstance(base, ast.Name)
+                            and _SUBMIT_NAME_RE.search(base.id)):
+                        yield self.finding(
+                            ctx, stmt, f"submit/start timestamp "
+                            f"`{base.id}` is captured AFTER blocking I/O "
+                            f"in this loop — the measured span excludes "
+                            f"the request and can go negative; capture "
+                            f"the timestamp before the I/O call")
+
+
+# --------------------------------------------------------------------- 105
+class StrayPrint(Rule):
+    """print/jax.debug.print/breakpoint left in library code.
+
+    Serving and training hot paths log through ``logging`` or structured
+    stderr writes; a bare print in library code is debug debris (and
+    ``jax.debug.print`` inside a jit inserts a host callback into the
+    compiled program). CLI entrypoints (``main``/``__main__`` blocks) and
+    prints with an explicit ``file=`` are the user interface — exempt.
+    """
+
+    id = "VMT105"
+    name = "stray-print"
+    severity = "warning"
+    description = "bare print()/jax.debug.print/breakpoint() in library code"
+    library_only = True
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved == "breakpoint":
+                yield self.finding(ctx, node,
+                                   "breakpoint() left in library code")
+            elif resolved == "jax.debug.print":
+                yield self.finding(
+                    ctx, node, "jax.debug.print in library code — inside "
+                    "a jit this compiles a host callback into the "
+                    "program; remove before shipping")
+            elif (resolved == "print" and not ctx.in_main_block(node)
+                    and not any(kw.arg == "file" for kw in node.keywords)):
+                yield self.finding(
+                    ctx, node, "bare print() in library code — use "
+                    "logging (or print(..., file=sys.stderr) for "
+                    "deliberate diagnostics)")
+
+
+# --------------------------------------------------------------------- 106
+class SqliteThreadSharing(Rule):
+    """A sqlite3 connection stored for cross-call reuse without a lock.
+
+    sqlite connections are not thread-safe; the serve tier runs HTTP,
+    worker, and push threads against the same databases. The repo pattern
+    is connection-per-call (serve/db.py, serve/queue.py) — a connection
+    parked on ``self``/module scope, or ``check_same_thread=False``,
+    without a ``threading.Lock`` in the same class is a data race.
+    """
+
+    id = "VMT106"
+    name = "sqlite-thread-sharing"
+    severity = "error"
+    description = ("sqlite3.connect result shared across threads without "
+                   "a lock")
+
+    @staticmethod
+    def _has_lock(cls_node: ast.ClassDef, ctx: ModuleContext) -> bool:
+        return any(
+            isinstance(n, ast.Call) and ctx.resolve(n.func) in
+            ("threading.Lock", "threading.RLock")
+            for n in ast.walk(cls_node))
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.resolve(node.func) == "sqlite3.connect"):
+                continue
+            cross_thread = any(
+                kw.arg == "check_same_thread"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords)
+            parent = ctx.parent(node)
+            stored = (isinstance(parent, ast.Assign) and any(
+                isinstance(t, ast.Attribute) or (
+                    isinstance(t, ast.Name)
+                    and ctx.enclosing_function(node) is None)
+                for t in parent.targets))
+            if not (stored or cross_thread):
+                continue
+            cls = next((a for a in ctx.ancestors(node)
+                        if isinstance(a, ast.ClassDef)), None)
+            if cls is not None and self._has_lock(cls, ctx):
+                continue
+            where = ("with check_same_thread=False" if cross_thread
+                     else "on shared state")
+            yield self.finding(
+                ctx, node, f"sqlite3 connection stored {where} without a "
+                f"threading.Lock — sqlite connections are not "
+                f"thread-safe; open a connection per call (the "
+                f"serve/db.py pattern) or guard every use with a lock")
+
+
+# --------------------------------------------------------------------- 107
+class SwallowedException(Rule):
+    """``except:``/``except Exception:`` whose body only passes.
+
+    In a worker/queue hot loop this turns a poisoned job or a dying
+    backend into silent job loss. Narrow exception types are fine;
+    ``__del__``/``__exit__`` teardown (where raising is worse) is exempt.
+    """
+
+    id = "VMT107"
+    name = "swallowed-exception"
+    severity = "warning"
+    description = "broad except clause that silently discards the error"
+
+    _TEARDOWN = {"__del__", "__exit__", "__aexit__"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or ctx.resolve(node.type) in (
+                "Exception", "BaseException")
+            trivial = all(
+                isinstance(s, (ast.Pass, ast.Continue)) for s in node.body)
+            if not (broad and trivial):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and fn.name in self._TEARDOWN:
+                continue
+            caught = ("bare except" if node.type is None
+                      else f"except {ctx.resolve(node.type)}")
+            yield self.finding(
+                ctx, node, f"{caught} swallows every error with "
+                f"`{'pass' if isinstance(node.body[0], ast.Pass) else 'continue'}`"
+                f" — in a hot loop this silently drops jobs; catch the "
+                f"specific exception or at least log it")
+
+
+# --------------------------------------------------------------------- 108
+_NP_CONSTRUCTORS = ("numpy.array", "numpy.zeros", "numpy.ones",
+                    "numpy.empty", "numpy.full", "numpy.arange",
+                    "numpy.linspace", "numpy.eye")
+_MUTATING_METHODS = {"fill", "sort", "put", "resize", "partition",
+                     "setfield", "itemset"}
+
+
+class ModuleLevelNumpyMutation(Rule):
+    """Functions mutating module-level numpy arrays in place.
+
+    A module-global ndarray mutated from functions is shared mutable state
+    that is invisible to jit tracing (baked in as a constant at trace
+    time, stale forever after) and unsafe under the serving threads.
+    """
+
+    id = "VMT108"
+    name = "module-numpy-mutation"
+    severity = "warning"
+    description = "in-place mutation of a module-level numpy array"
+
+    def _module_arrays(self, ctx: ModuleContext) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            if ctx.resolve(stmt.value.func) in _NP_CONSTRUCTORS:
+                out.update(t.id for t in stmt.targets
+                           if isinstance(t, ast.Name))
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        arrays = self._module_arrays(ctx)
+        if not arrays:
+            return
+        for node in ast.walk(ctx.tree):
+            if ctx.enclosing_function(node) is None:
+                continue
+            hit: Optional[str] = None
+            if (isinstance(node, (ast.Assign, ast.AugAssign))):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Name) and base.id in arrays \
+                            and (isinstance(t, ast.Subscript)
+                                 or isinstance(node, ast.AugAssign)):
+                        hit = base.id
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATING_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in arrays):
+                hit = node.func.value.id
+            if hit is not None:
+                yield self.finding(
+                    ctx, node, f"module-level numpy array `{hit}` is "
+                    f"mutated in place — jit traces bake it in as a "
+                    f"stale constant and the serving threads race on it; "
+                    f"pass state explicitly or make it immutable")
+
+
+RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
+         BenchTimingHazard, StrayPrint, SqliteThreadSharing,
+         SwallowedException, ModuleLevelNumpyMutation]
+
+
+def default_rules(severity_overrides: Optional[Dict[str, str]] = None
+                  ) -> List[Rule]:
+    """Instantiate the registry, applying per-repo severity overrides
+    (keys may be rule ids or names)."""
+    over = {k.lower(): v for k, v in (severity_overrides or {}).items()}
+    return [cls(severity=over.get(cls.id.lower(), over.get(cls.name.lower())))
+            for cls in RULES]

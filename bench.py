@@ -41,6 +41,9 @@ Env knobs:
 - ``BENCH_PROFILE_DIR`` capture a ``jax.profiler`` device trace of one
   warm round-robin pass into this directory (inspect with TensorBoard /
   xprof) — the diagnosis artifact for any surprising hardware number.
+- ``BENCH_TRACE_OUT`` write the measurement's span trace (engine tokenize /
+  features / forward / decode intervals) as Chrome-trace JSON to this path
+  (open at https://ui.perfetto.dev).
 - ``BENCH_WALL_BUDGET_S`` (7200) total wall budget for the orchestrator:
   attempts are sized to fit what remains, and no attempt starts that cannot
   finish inside it — a dead tunnel burns cheap probes, not 1800 s children.
@@ -57,14 +60,14 @@ log — so an outer rc=124 still leaves parseable evidence on stdout.
 from __future__ import annotations
 
 import json
-import math
 import os
-import statistics
 import subprocess
 import sys
 import time
 
 import numpy as np
+
+from vilbert_multitask_tpu.obs import dump_trace, percentile
 
 BASELINE_P50_MS = 150.0
 
@@ -180,11 +183,15 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
     # steady state: region tensors pin in HBM after first use and repeat
     # queries ship only the ~KB text payload. The cold (novel-upload) path
     # is measured separately below.
-    reqs = [
-        engine.prepare(task_id, q, regions[:n],
-                       cache_keys=[f"bench_img_{i}" for i in range(n)])
-        for task_id, q, n in ROUND_ROBIN
-    ]
+    reqs, tok_ms, feat_ms = [], [], []
+    for task_id, q, n in ROUND_ROBIN:
+        reqs.append(
+            engine.prepare(task_id, q, regions[:n],
+                           cache_keys=[f"bench_img_{i}" for i in range(n)]))
+        # Host-side stage costs are paid at prepare() time; with no feature
+        # store attached the "features" stage is the region encode.
+        tok_ms.append(engine.stage_times.get("tokenize_s", 0.0) * 1e3)
+        feat_ms.append(engine.stage_times.get("features_s", 0.0) * 1e3)
     # Warm exactly the buckets the timed loop hits: anything less recompiles
     # mid-measurement, anything more burns the one hardware run on compiles.
     buckets = sorted({r.bucket for r in reqs})
@@ -255,20 +262,27 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
             floor_ms.append((time.perf_counter() - t) * 1e3)
     except Exception as e:  # noqa: BLE001 — the floor is a bonus metric
         print(f"# dispatch-floor probe failed: {e}", file=sys.stderr)
+    # All percentiles through the one shared obs implementation (linear
+    # interpolation) — bench, serve, and the soak now agree on the math.
     return {
-        "dispatch_floor_ms": (round(statistics.median(floor_ms), 3)
+        "dispatch_floor_ms": (round(percentile(floor_ms, 0.5), 3)
                               if floor_ms else None),
         "warmup_s": round(warm_s, 1),
         "n_queries": len(lat_ms),
-        "cold_p50_ms": round(statistics.median(cold_ms), 3),
+        "cold_p50_ms": round(percentile(cold_ms, 0.5), 3),
         "buckets": buckets,
-        "p50_ms": round(statistics.median(lat_ms), 3),
-        # nearest-rank p95 (ceil), clamped: correct at small sample counts
-        "p95_ms": round(sorted(lat_ms)[min(
-            len(lat_ms) - 1, math.ceil(0.95 * len(lat_ms)) - 1)], 3),
-        "forward_p50_ms": round(statistics.median(fwd_ms), 3),
-        "decode_p50_ms": round(statistics.median(dec_ms), 3),
-        "achieved_tflops_p50": round(statistics.median(tflops), 4),
+        "p50_ms": round(percentile(lat_ms, 0.5), 3),
+        "p95_ms": round(percentile(lat_ms, 0.95), 3),
+        "forward_p50_ms": round(percentile(fwd_ms, 0.5), 3),
+        "decode_p50_ms": round(percentile(dec_ms, 0.5), 3),
+        "achieved_tflops_p50": round(percentile(tflops, 0.5), 4),
+        # Where a query's milliseconds go, host to host (p50 per stage).
+        "stage_ms": {
+            "tokenize": round(percentile(tok_ms, 0.5), 3),
+            "features": round(percentile(feat_ms, 0.5), 3),
+            "forward": round(percentile(fwd_ms, 0.5), 3),
+            "decode": round(percentile(dec_ms, 0.5), 3),
+        },
     }
 
 
@@ -431,6 +445,12 @@ def run_measurement() -> None:
     except Exception as e:  # noqa: BLE001 — throughput is a bonus metric
         print(f"# throughput pass failed: {e}", file=sys.stderr)
         thr = {}
+    trace_out = os.environ.get("BENCH_TRACE_OUT")
+    if trace_out:
+        # The engine spans recorded during _measure (tokenize / features /
+        # forward / decode per query) as a Perfetto-loadable artifact.
+        dump_trace(trace_out)
+        print(f"# span trace written to {trace_out}", file=sys.stderr)
     device_kind = jax.devices()[0].device_kind
     print(
         f"# device={device_kind} "
@@ -467,6 +487,7 @@ def run_measurement() -> None:
         "input_cache": engine.input_cache_stats,
         "forward_p50_ms": stats["forward_p50_ms"],
         "decode_p50_ms": stats["decode_p50_ms"],
+        "stage_ms": stats["stage_ms"],
         "dispatch_floor_ms": stats["dispatch_floor_ms"],
         "n_queries": stats["n_queries"],
         "buckets_timed": stats["buckets"],

@@ -59,7 +59,7 @@ def run(out_path: str | None = None, *, seed: int = 0,
     from vilbert_multitask_tpu.checkpoint.convert import convert_torch_state_dict
     from vilbert_multitask_tpu.config import ViLBertConfig
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     cfg = ViLBertConfig()  # FULL serving config — the point of this artifact
     # scale=0.05, tighter than the tiny-config tests' 0.35: at 1024-wide
     # trunks a +-0.35 uniform init saturates softmaxes/GELUs within a few
@@ -70,16 +70,16 @@ def run(out_path: str | None = None, *, seed: int = 0,
     inp = oracle_inputs(cfg, batch=batch, n_text=n_text, n_regions=n_regions,
                         seed=seed + 1, text_mask_tail=3, region_mask_tail=5)
     golden = torch_forward(oracle, inp)
-    t_torch = time.time()
+    t_torch = time.perf_counter()
 
     sd = numpy_state_dict(oracle)
     del oracle
     params = convert_torch_state_dict(sd, cfg, dtype=np.float64)
     del sd
-    t_convert = time.time()
+    t_convert = time.perf_counter()
 
     out = flax_forward(cfg, params, inp)
-    t_flax = time.time()
+    t_flax = time.perf_counter()
 
     heads = {}
     worst = 0.0
@@ -124,7 +124,7 @@ def run(out_path: str | None = None, *, seed: int = 0,
             "torch_forward": round(t_torch - t0, 2),
             "convert": round(t_convert - t_torch, 2),
             "flax_forward": round(t_flax - t_convert, 2),
-            "total": round(time.time() - t0, 2),
+            "total": round(time.perf_counter() - t0, 2),
         },
     }
     if out_path:

@@ -18,12 +18,9 @@ Usage: python scripts/serve_soak.py [--jobs 96] [--out SERVE_SOAK.json]
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import http.client
 import json
-import math
 import os
-import statistics
 import sys
 import tempfile
 import threading
@@ -104,6 +101,7 @@ def main(argv=None) -> int:
 
     from websockets.sync.client import connect
 
+    from vilbert_multitask_tpu.obs import Histogram, percentile
     from vilbert_multitask_tpu.serve.app import ServeApp
 
     root = tempfile.mkdtemp(prefix="serve_soak_")
@@ -170,9 +168,13 @@ def main(argv=None) -> int:
     ok = done.wait(timeout=600)
     app.stop()
 
-    lat_ms = sorted(
-        (arrivals[q] - t) * 1e3 for q, t in submitted.items()
-        if q in arrivals)
+    # Same histogram + percentile code as serve/metrics and bench — the
+    # soak's numbers are computed the one shared way.
+    e2e = Histogram("soak_e2e_ms", "Submit→result-frame latency (ms).")
+    for q, t in submitted.items():
+        if q in arrivals:
+            e2e.observe((arrivals[q] - t) * 1e3)
+    lat_ms = e2e.samples()
     n_done = len(lat_ms)
     # Throughput over the time results actually flowed: on a partial run
     # the wait timeout must not land in the denominator.
@@ -185,9 +187,9 @@ def main(argv=None) -> int:
         "jobs": args.jobs,
         "completed": n_done,
         "all_completed": bool(ok and n_done == args.jobs),
-        "e2e_p50_ms": round(statistics.median(lat_ms), 1) if lat_ms else None,
-        "e2e_p95_ms": (round(lat_ms[min(n_done - 1,
-                                        math.ceil(0.95 * n_done) - 1)], 1)
+        "e2e_p50_ms": (round(percentile(lat_ms, 0.5), 1)
+                       if lat_ms else None),
+        "e2e_p95_ms": (round(percentile(lat_ms, 0.95), 1)
                        if lat_ms else None),
         "makespan_s": round(makespan_s, 2),
         "boot_s": round(boot_s, 1),

@@ -118,6 +118,15 @@ def main(argv=None) -> int:
         "backend": dev.platform,
         **mem,
     }
+    # Per-step span timeline (train.data / train.step / train.checkpoint)
+    # next to the report — load at https://ui.perfetto.dev to see which
+    # steps carried first-occurrence compiles.
+    from vilbert_multitask_tpu.obs import dump_trace
+
+    trace_file = os.path.splitext(args.out)[0] + "_trace.json"
+    dump_trace(trace_file)
+    report["trace_file"] = trace_file
+
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report), flush=True)

@@ -397,6 +397,85 @@ def test_local_numpy_mutation_is_clean():
     assert "VMT108" not in rules_hit(src)
 
 
+# ----------------------------------------------------------------- VMT109
+def test_walltime_duration_triggers():
+    src = """
+    import time
+
+    def handler():
+        t0 = time.time()
+        work()
+        return time.time() - t0
+    """
+    assert "VMT109" in rules_hit(src)
+
+
+def test_walltime_attribute_anchor_triggers():
+    # self._started = time.time() in one method, subtracted in another.
+    src = """
+    import time
+
+    class M:
+        def __init__(self):
+            self._started = time.time()
+
+        def uptime(self):
+            return time.time() - self._started
+    """
+    assert "VMT109" in rules_hit(src)
+
+
+def test_perf_counter_duration_is_clean():
+    src = """
+    import time
+
+    def handler():
+        t0 = time.perf_counter()
+        work()
+        return time.perf_counter() - t0
+    """
+    assert "VMT109" not in rules_hit(src)
+
+
+def test_walltime_timestamp_without_subtraction_is_clean():
+    # Stamping an event with wall-clock time is the legitimate use.
+    src = """
+    import time
+
+    def stamp(job):
+        job["submitted_at"] = time.time()
+        return job
+    """
+    assert "VMT109" not in rules_hit(src)
+
+
+def test_walltime_anchor_is_function_scoped():
+    # A name assigned from time.time() in one function must not taint the
+    # same name in another function.
+    src = """
+    import time
+
+    def a():
+        t0 = time.time()
+        return t0
+
+    def b():
+        t0 = 1.0
+        return 2.0 - t0
+    """
+    assert "VMT109" not in rules_hit(src)
+
+
+def test_walltime_duration_suppressible():
+    src = """
+    import time
+
+    def deadline_left(stamp):
+        return 30.0 - (time.time() - stamp)  # vmtlint: disable=VMT109
+    """
+    assert "VMT109" not in rules_hit(src)
+
+
 # ----------------------------------------------- suppressions and baseline
 def test_inline_suppression_by_id_name_and_next_line():
     base = """

@@ -48,6 +48,18 @@ def test_baseline_has_no_stale_entries():
             f"  {fp} ({baseline[fp].get('path')})" for fp in stale)
 
 
+def test_scan_set_covers_obs_and_vmt109_is_active():
+    # The obs/ package must sit inside the configured scan set (it lives
+    # under the library root, so no separate path entry is needed) and the
+    # wall-clock-duration rule must be registered — otherwise the "obs code
+    # is lint-clean" guarantee silently stops meaning anything.
+    cfg, root = load_config(REPO_ROOT)
+    obs_dir = os.path.join(root, "vilbert_multitask_tpu", "obs")
+    assert os.path.isdir(obs_dir)
+    assert any(obs_dir.startswith(os.path.join(root, p)) for p in cfg.paths)
+    assert "VMT109" in {r.id for r in default_rules()}
+
+
 def test_baseline_entries_carry_justification():
     _, baseline = _scan()
     missing = [fp for fp, e in baseline.items()

@@ -746,3 +746,138 @@ def test_device_cache_misses_when_feature_file_changes(stack, features_dir):
     assert worker.step() == "acked"
     new_keys = [k for k in eng._input_cache if k not in keys_before]
     assert new_keys, "changed file content must mint a NEW cache key"
+
+
+# ----------------------------------------------------------- observability
+def test_end_to_end_single_trace(stack):
+    """The ISSUE-2 acceptance path: one HTTP-submitted request yields ONE
+    correlated trace (a single trace_id) spanning submit → queue claim →
+    worker → engine stages → push, retrievable as valid Chrome-trace JSON
+    from /debug/trace."""
+    from vilbert_multitask_tpu import obs
+
+    s, hub, q, store, worker = stack
+    api = ApiServer(q, store, hub, s, metrics=worker.metrics)
+    port = api.start()
+    obs.default_tracer().clear()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("POST", "/", body=json.dumps({
+            "task_id": 1, "socket_id": "sockT", "question": "what is this",
+            "image_list": ["img_a.jpg"],
+        }), headers={"Content-Type": "application/json"})
+        resp = json.loads(conn.getresponse().read())
+        trace_id = resp["trace_id"]
+        assert trace_id and resp["job_id"]
+
+        assert worker.step() == "acked"  # claims + runs on this thread
+
+        conn.request("GET", "/debug/trace")
+        doc = json.loads(conn.getresponse().read())
+    finally:
+        api.stop()
+
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], e)
+    # every tier of the request pipeline reported in
+    for name in ("http.submit", "worker.claim", "worker.job",
+                 "worker.intake", "engine.features", "engine.tokenize",
+                 "worker.infer", "engine.forward", "engine.decode",
+                 "worker.persist", "worker.push"):
+        assert name in by_name, f"missing span {name}: {sorted(by_name)}"
+    # ... and all under the ONE trace id minted at submit
+    correlated = {e["name"] for e in events
+                  if e["args"]["trace_id"] == trace_id}
+    assert {"http.submit", "worker.claim", "worker.job", "worker.intake",
+            "worker.infer", "engine.forward", "engine.decode",
+            "worker.persist", "worker.push"} <= correlated
+    # parenting: engine.forward sits under worker.infer under worker.job
+    fwd = by_name["engine.forward"]
+    infer = by_name["worker.infer"]
+    assert fwd["args"]["parent_id"] == infer["args"]["span_id"]
+    assert infer["args"]["parent_id"] == by_name["worker.job"]["args"][
+        "span_id"]
+
+
+def test_metrics_prometheus_exposition(stack):
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "what", 1, "sockP"))
+    worker.step_batch()
+    q.publish(make_job_message(["img_b.jpg"], "held back", 1, "sockP"))
+
+    api = ApiServer(
+        q, store, hub, s, metrics=worker.metrics,
+        stats_fn=lambda: {"input_cache": worker.engine.input_cache_stats})
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/metrics?format=prometheus")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith(
+            "text/plain; version=0.0.4")
+        text = resp.read().decode()
+
+        # JSON mode still serves on the same path
+        conn.request("GET", "/metrics")
+        assert "latency_ms" in json.loads(conn.getresponse().read())
+    finally:
+        api.stop()
+
+    lines = text.splitlines()
+    # parseable exposition: every sample line is `name{labels} value`
+    for ln in lines:
+        if ln and not ln.startswith("#"):
+            name_part, value = ln.rsplit(" ", 1)
+            float(value)
+            assert name_part
+    # queue-depth gauges from DurableQueue.counts()
+    assert 'vmt_queue_jobs{state="pending"} 1' in lines
+    assert 'vmt_queue_jobs{state="inflight"} 0' in lines
+    assert 'vmt_queue_jobs{state="dead"} 0' in lines
+    # engine cache stats rode through stats_fn
+    assert any(ln.startswith('vmt_input_cache{key="hits"}') for ln in lines)
+    # per-task stage histograms (the span->histogram observer bridge)
+    assert any(ln.startswith(
+        'vmt_span_ms_bucket{name="engine.forward",task="1"') for ln in lines)
+    # the request-latency histogram (Metrics) is exposed too
+    assert any(ln.startswith('request_latency_ms_bucket{task="1"')
+               for ln in lines)
+
+
+def test_debug_profile_endpoints(stack, tmp_path, monkeypatch):
+    calls = []
+    from vilbert_multitask_tpu.serve import metrics as metrics_mod
+
+    monkeypatch.setattr(metrics_mod, "start_device_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(metrics_mod, "stop_device_trace",
+                        lambda: calls.append(("stop",)))
+
+    s, hub, q, store, worker = stack
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    log_dir = str(tmp_path / "prof")
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("POST", "/debug/profile/start",
+                     body=json.dumps({"log_dir": log_dir}),
+                     headers={"Content-Type": "application/json"})
+        r1 = conn.getresponse()
+        ok1 = json.loads(r1.read())
+        assert r1.status == 200 and ok1 == {"ok": True, "log_dir": log_dir}
+        # double-start refuses (jax supports one trace at a time)
+        conn.request("POST", "/debug/profile/start", body="{}")
+        r2 = conn.getresponse()
+        assert r2.status == 409 and not json.loads(r2.read())["ok"]
+        conn.request("POST", "/debug/profile/stop", body="")
+        r3 = conn.getresponse()
+        assert r3.status == 200 and json.loads(r3.read())["ok"]
+        # stop with nothing running refuses too
+        conn.request("POST", "/debug/profile/stop", body="")
+        assert conn.getresponse().status == 409
+    finally:
+        api.stop()
+    assert calls == [("start", log_dir), ("stop",)]

@@ -26,6 +26,9 @@ checkpoint. This package re-designs every layer TPU-first:
                   (reference: demo/ Django app + pika, SURVEY.md L3-L6).
 - ``native/``     C++ runtime pieces (NMS, feature store IO) built with g++,
                   bound via ctypes (reference: maskrcnn_benchmark native ops).
+- ``obs/``        span tracing, counters/gauges/histograms, Prometheus and
+                  Chrome-trace exporters (reference: one wall-clock print per
+                  job, worker.py:657-658).
 """
 
 __version__ = "0.1.0"

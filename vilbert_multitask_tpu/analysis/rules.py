@@ -573,9 +573,79 @@ class ModuleLevelNumpyMutation(Rule):
                     f"pass state explicitly or make it immutable")
 
 
+# --------------------------------------------------------------------- 109
+class WallClockDuration(Rule):
+    """``time.time()`` used in duration arithmetic.
+
+    The wall clock steps under NTP slew/adjustment, so a latency computed
+    from it can jump or go negative; monotonic ``time.perf_counter()`` is
+    the duration clock everywhere in this repo (the obs tracer refuses
+    wall clock entirely). Legitimate wall-clock subtraction exists —
+    uptime reporting, deadline math against persisted cross-process
+    timestamps — and is suppressed inline with a justification.
+    """
+
+    id = "VMT109"
+    name = "wallclock-duration"
+    severity = "error"
+    description = ("time.time() used to compute a duration/latency — "
+                   "use monotonic time.perf_counter()")
+
+    def _is_walltime(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and ctx.resolve(node.func) == "time.time")
+
+    def _anchors(self, ctx: ModuleContext
+                 ) -> Tuple[Set[Tuple[int, str]], Set[str]]:
+        """Targets assigned from time.time(): plain names scoped to their
+        enclosing function (id(fn) or 0 at module level), attribute
+        targets (``self._t0 = time.time()``) module-wide by source text."""
+        names: Set[Tuple[int, str]] = set()
+        attrs: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and self._is_walltime(ctx, node.value)):
+                continue
+            fn = ctx.enclosing_function(node)
+            scope = id(fn) if fn is not None else 0
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add((scope, t.id))
+                elif isinstance(t, ast.Attribute):
+                    attrs.add(ast.unparse(t))
+        return names, attrs
+
+    def _matches(self, ctx: ModuleContext, operand: ast.AST, scope: int,
+                 names: Set[Tuple[int, str]], attrs: Set[str]) -> bool:
+        if self._is_walltime(ctx, operand):
+            return True
+        if isinstance(operand, ast.Name):
+            return (scope, operand.id) in names
+        if isinstance(operand, ast.Attribute):
+            return ast.unparse(operand) in attrs
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        names, attrs = self._anchors(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            fn = ctx.enclosing_function(node)
+            scope = id(fn) if fn is not None else 0
+            if (self._matches(ctx, node.left, scope, names, attrs)
+                    or self._matches(ctx, node.right, scope, names, attrs)):
+                yield self.finding(
+                    ctx, node, "duration computed from the wall clock "
+                    "(time.time()) — NTP slew makes it jump or go "
+                    "negative; measure spans with the monotonic "
+                    "time.perf_counter() (or suppress with a "
+                    "justification if this really is calendar math)")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
-         SwallowedException, ModuleLevelNumpyMutation]
+         SwallowedException, ModuleLevelNumpyMutation, WallClockDuration]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None

@@ -57,7 +57,14 @@ from vilbert_multitask_tpu.features.pipeline import (
 from vilbert_multitask_tpu.features.store import FeatureStore
 from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks, ViLBertOutput
 from vilbert_multitask_tpu.parallel import sharding as shd
-from vilbert_multitask_tpu import assets
+from vilbert_multitask_tpu import assets, obs
+
+# XLA compiles are the dominant "why did THIS request take 4 s" answer;
+# the counter makes them visible next to the queue gauges in /metrics.
+_COMPILES = obs.REGISTRY.counter(
+    "vmt_engine_compiles_total",
+    "jit program compilations by program family.",
+    labelnames=("program",))
 from vilbert_multitask_tpu.text.pipeline import EncodedText, encode_question
 from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
 
@@ -312,6 +319,7 @@ class InferenceEngine:
         batch shardings as one (bucket, ...) tree per call)."""
         key = ("batched", bucket, collect_attention, self._model_gen)
         if key not in self._compiled:
+            _COMPILES.inc(program="batched")
             model = self.model
 
             @partial(jax.jit, static_argnames=("attn",))
@@ -339,6 +347,7 @@ class InferenceEngine:
         way, no extra dispatch for the stack."""
         key = ("rows", bucket, collect_attention, self._model_gen)
         if key not in self._compiled:
+            _COMPILES.inc(program="rows")
             model = self.model
 
             @partial(jax.jit, static_argnames=("attn",))
@@ -494,15 +503,24 @@ class InferenceEngine:
             raise RuntimeError("prepare_from_store() needs a FeatureStore; "
                                "use prepare() with in-memory regions instead")
         fetch = getattr(self.feature_store, "fetch", None)
-        if fetch is not None:
-            pairs = [fetch(p) for p in image_paths]
-            regions = [r for r, _ in pairs]
-            cache_keys: Optional[List[str]] = [k for _, k in pairs]
-        else:
-            regions = self.feature_store.get_batch(image_paths)
-            cache_keys = None
-        return self.prepare(task_id, question, regions, image_paths,
-                            cache_keys=cache_keys)
+        t_fetch = time.perf_counter()
+        with obs.span("engine.features", source="store",
+                      n_images=len(image_paths), task_id=task_id):
+            if fetch is not None:
+                pairs = [fetch(p) for p in image_paths]
+                regions = [r for r, _ in pairs]
+                cache_keys: Optional[List[str]] = [k for _, k in pairs]
+            else:
+                regions = self.feature_store.get_batch(image_paths)
+                cache_keys = None
+        fetch_s = time.perf_counter() - t_fetch
+        req = self.prepare(task_id, question, regions, image_paths,
+                           cache_keys=cache_keys)
+        # prepare() booked the host-side region encode; the store read
+        # belongs to the same "features" stage.
+        self.stage_times["features_s"] = (
+            self.stage_times.get("features_s", 0.0) + fetch_s)
+        return req
 
     @property
     def transfer_dtype(self) -> np.dtype:
@@ -542,17 +560,24 @@ class InferenceEngine:
         ecfg = self.cfg.engine
         bucket = n if n == 1 else ecfg.bucket_for(n)
 
-        text = encode_question(
-            self.tokenizer, question, ecfg.max_text_len, task_id=task_id,
-            lowercase=self.cfg.serving.lowercase_questions,
-        ).stack(bucket)
-        # Feature files are confidence-ordered (extractor top-K order, same
-        # as the reference's .npy dumps), so an over-provisioned store clips
-        # to this engine's region budget instead of erroring.
-        regions = clip_regions(regions, ecfg.max_regions)
-        encoded = [encode_image(r, ecfg.max_regions) for r in regions]
-        feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
-        feats = feats.astype(self.transfer_dtype, copy=False)
+        t_tok = time.perf_counter()
+        with obs.span("engine.tokenize", task_id=task_id):
+            text = encode_question(
+                self.tokenizer, question, ecfg.max_text_len, task_id=task_id,
+                lowercase=self.cfg.serving.lowercase_questions,
+            ).stack(bucket)
+        self.stage_times["tokenize_s"] = time.perf_counter() - t_tok
+        t_feat = time.perf_counter()
+        with obs.span("engine.features", source="encode", n_images=n,
+                      task_id=task_id):
+            # Feature files are confidence-ordered (extractor top-K order,
+            # same as the reference's .npy dumps), so an over-provisioned
+            # store clips to this engine's region budget instead of erroring.
+            regions = clip_regions(regions, ecfg.max_regions)
+            encoded = [encode_image(r, ecfg.max_regions) for r in regions]
+            feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
+            feats = feats.astype(self.transfer_dtype, copy=False)
+        self.stage_times["features_s"] = time.perf_counter() - t_feat
         task_ids = np.full((bucket, 1), task_id, np.int32)
         if cache_keys is not None:
             if len(cache_keys) != n:
@@ -677,26 +702,34 @@ class InferenceEngine:
             input_mask=req.text.input_mask, task_ids=req.task_ids,
         )
         t0 = time.perf_counter()
-        if self.mesh is not None:
-            # Mesh serving ships the batched tree with batch shardings (a
-            # local multi-chip host: PCIe upload is cheap; the row cache is
-            # a single-device optimization).
-            batch = {**text, "features": req.features,
-                     "spatials": req.spatials, "image_mask": req.image_mask}
-            batch = shd.place_batch(batch, self.mesh)
-            out, bundle = self._call_forward(req.bucket, collect_attention,
-                                             batch)
-        else:
-            feat_rows, spat_rows, mask_rows = self._image_rows(req)
-            out, bundle = self._call_forward(
-                req.bucket, collect_attention, text,
-                feat_rows, spat_rows, mask_rows, rows=True)
-        # One blocking fetch of the few-KB decode bundle — forward_s includes
-        # the single device→host round trip; decode is then pure host math.
-        bundle = jax.device_get(bundle)
+        # The forward span closes only after the blocking device_get below —
+        # jax dispatch is async, so fencing on the fetch is what makes the
+        # span (and forward_s) measure device time instead of enqueue time.
+        with obs.span("engine.forward", bucket=req.bucket,
+                      task_id=req.spec.task_id):
+            if self.mesh is not None:
+                # Mesh serving ships the batched tree with batch shardings (a
+                # local multi-chip host: PCIe upload is cheap; the row cache
+                # is a single-device optimization).
+                batch = {**text, "features": req.features,
+                         "spatials": req.spatials,
+                         "image_mask": req.image_mask}
+                batch = shd.place_batch(batch, self.mesh)
+                out, bundle = self._call_forward(req.bucket,
+                                                 collect_attention, batch)
+            else:
+                feat_rows, spat_rows, mask_rows = self._image_rows(req)
+                out, bundle = self._call_forward(
+                    req.bucket, collect_attention, text,
+                    feat_rows, spat_rows, mask_rows, rows=True)
+            # One blocking fetch of the few-KB decode bundle — forward_s
+            # includes the single device→host round trip; decode is then
+            # pure host math.
+            bundle = jax.device_get(bundle)
         self.stage_times["forward_s"] = time.perf_counter() - t0
         t0 = time.perf_counter()
-        result = self.decode(req, bundle)
+        with obs.span("engine.decode", task_id=req.spec.task_id):
+            result = self.decode(req, bundle)
         self.stage_times["decode_s"] = time.perf_counter() - t0
         return out, result
 
@@ -744,18 +777,21 @@ class InferenceEngine:
             c, bundle = pending.popleft()
             bundle = jax.device_get(bundle)
             td = time.perf_counter()
-            row = 0
-            for pos, r in c:
-                out[pos] = self.decode(r, bundle, row=row)
-                row += r.n_images
+            with obs.span("engine.decode", n_requests=len(c)):
+                row = 0
+                for pos, r in c:
+                    out[pos] = self.decode(r, bundle, row=row)
+                    row += r.n_images
             dec_s += time.perf_counter() - td
 
-        for c in chunks:
-            pending.append((c, self._dispatch_many([r for _, r in c])))
-            if len(pending) >= self._MAX_INFLIGHT_CHUNKS:
+        with obs.span("engine.run_many", n_requests=len(reqs),
+                      n_chunks=len(chunks)):
+            for c in chunks:
+                pending.append((c, self._dispatch_many([r for _, r in c])))
+                if len(pending) >= self._MAX_INFLIGHT_CHUNKS:
+                    _drain_one()
+            while pending:
                 _drain_one()
-        while pending:
-            _drain_one()
         # forward_s = dispatch + device + fetch wall time; host decode is
         # booked separately (same split as run()).
         self.stage_times["forward_s"] = time.perf_counter() - t0 - dec_s

@@ -1,0 +1,70 @@
+"""Process-wide observability: span tracing, instruments, exporters.
+
+Three pillars (see ARCHITECTURE.md "Observability"):
+
+- ``obs.span("engine.forward", task_id=...)`` — monotonic-clocked spans
+  with thread-local parenting and cross-queue trace-id resumption
+  (:mod:`vilbert_multitask_tpu.obs.trace`);
+- ``obs.REGISTRY`` — counters / gauges / log-bucket histograms, plus the
+  one shared :func:`percentile` used by serve, bench, and the soak
+  (:mod:`vilbert_multitask_tpu.obs.instruments`);
+- Prometheus text exposition, Chrome-trace JSON, and ``jax.profiler``
+  toggles (:mod:`vilbert_multitask_tpu.obs.export`).
+
+Importing the package wires the default tracer's observer to feed every
+completed span into the ``vmt_span_ms{name,task}`` histogram, which is
+what ``GET /metrics?format=prometheus`` serves as per-task stage
+latencies.
+"""
+
+from __future__ import annotations
+
+from vilbert_multitask_tpu.obs.trace import (
+    Span,
+    Tracer,
+    current_trace_id,
+    default_tracer,
+    new_trace_id,
+    span,
+    trace_scope,
+)
+from vilbert_multitask_tpu.obs.instruments import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+    log_buckets,
+    percentile,
+)
+from vilbert_multitask_tpu.obs.export import (
+    PROMETHEUS_CONTENT_TYPE,
+    chrome_trace,
+    dump_trace,
+    render_prometheus,
+    start_profile,
+    stop_profile,
+)
+
+__all__ = [
+    "Span", "Tracer", "current_trace_id", "default_tracer", "new_trace_id",
+    "span", "trace_scope",
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "log_buckets", "percentile",
+    "PROMETHEUS_CONTENT_TYPE", "chrome_trace", "dump_trace",
+    "render_prometheus", "start_profile", "stop_profile",
+]
+
+SPAN_HISTOGRAM = REGISTRY.histogram(
+    "vmt_span_ms",
+    "Span durations by span name and task (ms).",
+    labelnames=("name", "task"),
+)
+
+
+def _observe_span(s: Span) -> None:
+    SPAN_HISTOGRAM.observe(
+        s.dur_s * 1e3, name=s.name, task=str(s.attrs.get("task_id", "")))
+
+
+default_tracer().set_observer(_observe_span)

@@ -1,0 +1,260 @@
+"""Counters, gauges, and log-bucket histograms — one implementation.
+
+Before this package, percentile math lived in three places with three
+semantics: ``serve/metrics.py`` (upward-biased nearest-rank — p50 of two
+samples returned the max), ``bench.py`` (``statistics.median`` + manual
+ceil nearest-rank p95), and ``scripts/serve_soak.py`` (a third variant).
+:func:`percentile` below is now the only one; ``Metrics``, the bench, and
+the soak all route through it (linear interpolation — exact median, no
+off-by-one bias).
+
+The :class:`Histogram` keeps fixed log-spaced buckets (Prometheus
+exposition needs cumulative bucket counts) *and* a bounded reservoir of
+raw samples (exact percentiles for JSON snapshots and bench artifacts) —
+"replacing/augmenting the reservoir" per the round-6 telemetry design.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def percentile(values: Sequence[float], p: float) -> Optional[float]:
+    """Linear-interpolated percentile of raw samples, ``p`` in [0, 1].
+
+    THE shared implementation: index space is ``p * (n - 1)`` (not the
+    upward-biased ``p * n``), interpolating between the two neighboring
+    order statistics. ``percentile(xs, 0.5)`` equals ``statistics.median``.
+    Returns None on an empty sample set.
+    """
+    if not values:
+        return None
+    xs = sorted(float(v) for v in values)
+    if len(xs) == 1:
+        return xs[0]
+    k = min(max(p, 0.0), 1.0) * (len(xs) - 1)
+    lo = int(k)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (k - lo)
+
+
+def log_buckets(lo: float = 0.1, hi: float = 60_000.0,
+                per_decade: int = 4) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket upper bounds (defaults: 0.1 ms … 60 s in
+    quarter-decade steps — latency-shaped). Deterministic, so every
+    histogram in the process exposes comparable buckets."""
+    out: List[float] = []
+    k = math.ceil(round(math.log10(lo) * per_decade, 9))
+    while True:
+        bound = round(10 ** (k / per_decade), 6)
+        out.append(bound)
+        if bound >= hi:
+            break
+        k += 1
+    return tuple(out)
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+
+class Counter(_Instrument):
+    """Monotonically increasing value per label set."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def collect(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(_Instrument):
+    """Point-in-time value per label set (queue depth, cache entries)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._values.get(self._key(labels))
+
+    def collect(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class _HistSeries:
+    """One label set's state: bucket counts + count/sum + raw reservoir."""
+
+    __slots__ = ("counts", "count", "sum", "reservoir")
+
+    def __init__(self, n_buckets: int, reservoir: int):
+        self.counts = [0] * (n_buckets + 1)  # +1: the implicit +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.reservoir: deque = deque(maxlen=reservoir)
+
+
+class Histogram(_Instrument):
+    """Fixed log-bucket histogram with an exact-percentile reservoir.
+
+    ``le`` semantics match Prometheus: a sample lands in the first bucket
+    whose upper bound is >= the value; exposition cumulates the counts.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None,
+                 reservoir: int = 2048):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets)) if buckets else log_buckets()
+        self._reservoir = reservoir
+        self._series: Dict[Tuple[str, ...], _HistSeries] = {}
+
+    def _get_series(self, key: Tuple[str, ...]) -> _HistSeries:
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = _HistSeries(
+                len(self.buckets), self._reservoir)
+        return series
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        value = float(value)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            series = self._get_series(key)
+            series.counts[i] += 1
+            series.count += 1
+            series.sum += value
+            series.reservoir.append(value)
+
+    # ----------------------------------------------------------- inspection
+    def samples(self, **labels) -> List[float]:
+        """Raw reservoir for one label set (newest ``reservoir`` samples)."""
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return list(series.reservoir) if series else []
+
+    def all_samples(self) -> List[float]:
+        """Reservoirs merged across every label set."""
+        with self._lock:
+            return [v for s in self._series.values() for v in s.reservoir]
+
+    def percentile(self, p: float, **labels) -> Optional[float]:
+        """Exact percentile over the reservoir via the one shared
+        implementation (merged across label sets when none are given on a
+        labeled histogram)."""
+        if not labels and self.labelnames:
+            return percentile(self.all_samples(), p)
+        return percentile(self.samples(**labels), p)
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series.count if series else 0
+
+    def series_counts(self) -> Dict[Tuple[str, ...], int]:
+        """Observation count per label set (per-task request counts)."""
+        with self._lock:
+            return {k: s.count for k, s in self._series.items()}
+
+    def collect(self) -> Dict[Tuple[str, ...], dict]:
+        """Per-label-set {"buckets": [(le, cumulative)...], "count", "sum"}
+        — cumulativity is applied here, the one place exposition reads."""
+        out: Dict[Tuple[str, ...], dict] = {}
+        with self._lock:
+            for key, series in self._series.items():
+                cumulative, acc = [], 0
+                for bound, n in zip(self.buckets, series.counts):
+                    acc += n
+                    cumulative.append((bound, acc))
+                cumulative.append((math.inf, series.count))
+                out[key] = {"buckets": cumulative, "count": series.count,
+                            "sum": series.sum}
+        return out
+
+
+class Registry:
+    """Name-keyed get-or-create instrument store (one per process is the
+    normal mode — :data:`REGISTRY`); re-registration with a different
+    type or label set is a programming error and raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get(self, cls, name: str, help: str,
+             labelnames: Sequence[str], **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(
+                    name, help, labelnames, **kwargs)
+            elif type(inst) is not cls or inst.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"instrument {name!r} already registered as "
+                    f"{inst.kind} with labels {inst.labelnames}")
+            return inst
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get(Histogram, name, help, labelnames, buckets=buckets)
+
+    def instruments(self) -> List[_Instrument]:
+        with self._lock:
+            return list(self._instruments.values())
+
+
+REGISTRY = Registry()

@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Optional
 
+from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import FrameworkConfig
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 from vilbert_multitask_tpu.features.store import FeatureStore
@@ -100,8 +101,9 @@ class ServeApp:
                                              media_root=s.media_root)
                 self.boot_info["live_extract"] = True
             t0 = time.perf_counter()
-            engine = InferenceEngine(
-                self.cfg, params=params, mesh=mesh, feature_store=store)
+            with obs.span("serve.boot"):
+                engine = InferenceEngine(
+                    self.cfg, params=params, mesh=mesh, feature_store=store)
             self.boot_info["engine_init_s"] = round(
                 time.perf_counter() - t0, 1)
         self.engine = engine
@@ -122,10 +124,12 @@ class ServeApp:
         debug-only everywhere in this binary — a first upload must never
         pay the detector JIT inside the worker thread."""
         t0 = time.perf_counter()
-        self.engine.warmup()
-        if self.extractor is not None:
-            self.extractor.warmup()
-            self.boot_info["detector_warm"] = True
+        with obs.span("serve.warmup",
+                      buckets=list(self.cfg.engine.all_row_buckets())):
+            self.engine.warmup()
+            if self.extractor is not None:
+                self.extractor.warmup()
+                self.boot_info["detector_warm"] = True
         self.boot_info.update(
             warmup_s=round(time.perf_counter() - t0, 1),
             buckets=list(self.cfg.engine.all_row_buckets()),

@@ -30,6 +30,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import ServingConfig, TASK_REGISTRY
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
@@ -66,6 +67,18 @@ class ApiServer:
 
     # ------------------------------------------------------------- handlers
     def submit_job(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        # Trace root: the id minted here rides in the queue job body and is
+        # re-entered by the worker, correlating one request's spans across
+        # the HTTP handler / worker thread boundary.
+        trace_id = obs.new_trace_id()
+        with obs.trace_scope(trace_id), obs.span("http.submit") as sp:
+            code, body = self._submit_job(payload, trace_id, sp)
+        if code == 200:
+            body["trace_id"] = trace_id
+        return code, body
+
+    def _submit_job(self, payload: Dict[str, Any], trace_id: str,
+                    sp) -> Tuple[int, Dict[str, Any]]:
         try:
             task_id = int(payload["task_id"])
             socket_id = str(payload.get("socket_id", ""))
@@ -91,7 +104,9 @@ class ApiServer:
                 # "full" passes through (complete per-head maps persisted);
                 # any other truthy value → compact summary.
                 collect_attention=("full" if collect == "full"
-                                   else bool(collect))))
+                                   else bool(collect)),
+                trace_id=trace_id))
+        sp.set(task_id=task_id, job_id=job_id, n_images=len(images))
         return 200, {"job_id": job_id, "task": spec.name}
 
     def task_details(self, task_id: int) -> Tuple[int, Dict[str, Any]]:
@@ -128,6 +143,28 @@ class ApiServer:
         with open(path, "wb") as f:
             f.write(data)
         return path
+
+    def refresh_gauges(self) -> None:
+        """Refresh point-in-time gauges on each Prometheus scrape (pull
+        model: queue depth and cache occupancy are read, not pushed)."""
+        g = obs.REGISTRY.gauge(
+            "vmt_queue_jobs", "Durable queue jobs by state.",
+            labelnames=("state",))
+        counts = self.queue.counts()
+        for state in ("pending", "inflight", "dead"):
+            g.set(counts.get(state, 0), state=state)
+        if self.stats_fn is not None:
+            try:
+                stats = self.stats_fn()
+            except Exception:  # noqa: BLE001 — stats best-effort
+                stats = {}
+            cache = stats.get("input_cache") or {}
+            if cache:
+                cg = obs.REGISTRY.gauge(
+                    "vmt_input_cache", "Engine device input cache stats.",
+                    labelnames=("key",))
+                for key, value in cache.items():
+                    cg.set(value, key=str(key))
 
     # --------------------------------------------------------------- server
     def _make_handler(self):
@@ -215,7 +252,15 @@ class ApiServer:
                 elif path == "/healthz":
                     self._json(200, {"ok": True, "queue": api.queue.counts(),
                                      "boot": api.boot_info})
-                elif path == "/metrics":
+                elif path == "/metrics" or path.startswith("/metrics?"):
+                    # NB: ``path`` retains the query string (rstrip only
+                    # trims slashes), hence the startswith branch.
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    if q.get("format", [""])[0] == "prometheus":
+                        self._serve_prometheus()
+                        return
                     snap = (api.metrics.snapshot()
                             if api.metrics is not None else {})
                     snap["queue"] = api.queue.counts()
@@ -225,12 +270,34 @@ class ApiServer:
                         except Exception:  # noqa: BLE001 — stats best-effort
                             pass
                     self._json(200, snap)
+                elif path == "/debug/trace" or path.startswith("/debug/trace?"):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["0"])[0]) or None
+                    except ValueError:
+                        limit = None
+                    self._json(200, obs.chrome_trace(limit=limit))
                 else:
                     self._json(404, {"error": "not found"})
 
             def _wants_html(self) -> bool:
                 """Browser-vs-API content negotiation (one place)."""
                 return "text/html" in self.headers.get("Accept", "")
+
+            def _serve_prometheus(self) -> None:
+                api.refresh_gauges()
+                extra = ([api.metrics.latency]
+                         if api.metrics is not None
+                         and hasattr(api.metrics, "latency") else [])
+                body = obs.render_prometheus(extra=extra).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 obs.PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _serve_static_page(self, name: str):
                 page = os.path.join(os.path.dirname(__file__), "static",
@@ -336,6 +403,20 @@ class ApiServer:
                     self._handle_worker(path, raw)
                 elif path.startswith("/admin/"):
                     self._handle_admin_edit(path, raw)
+                elif path == "/debug/profile/start":
+                    try:
+                        p = json.loads(raw or b"{}")
+                    except json.JSONDecodeError:
+                        self._json(400, {"error": "invalid JSON"})
+                        return
+                    log_dir = str(p.get("log_dir", "")) or os.path.join(
+                        api.serving.media_root, "profiles")
+                    os.makedirs(log_dir, exist_ok=True)
+                    res = obs.start_profile(log_dir)
+                    self._json(200 if res["ok"] else 409, res)
+                elif path == "/debug/profile/stop":
+                    res = obs.stop_profile()
+                    self._json(200 if res["ok"] else 409, res)
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -450,19 +531,21 @@ class ApiServer:
                 if "multipart/form-data" not in ctype:
                     self._json(400, {"error": "expected multipart/form-data"})
                     return
-                msg = email.message_from_bytes(
-                    b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + raw,
-                    policy=email.policy.HTTP,
-                )
-                paths = []
-                for part in msg.iter_parts():
-                    name = part.get_filename()
-                    if not name:
-                        continue
-                    if len(paths) >= api.serving.max_upload_images:
-                        break  # reference caps uploads (demo_images.html:92-95)
-                    paths.append(api.save_upload(
-                        name, part.get_payload(decode=True) or b""))
+                with obs.span("http.upload", bytes=len(raw)) as sp:
+                    msg = email.message_from_bytes(
+                        b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + raw,
+                        policy=email.policy.HTTP,
+                    )
+                    paths = []
+                    for part in msg.iter_parts():
+                        name = part.get_filename()
+                        if not name:
+                            continue
+                        if len(paths) >= api.serving.max_upload_images:
+                            break  # reference caps uploads (demo_images.html:92-95)
+                        paths.append(api.save_upload(
+                            name, part.get_payload(decode=True) or b""))
+                    sp.set(n_files=len(paths))
                 self._json(200, {"file_paths": paths})
 
         return Handler

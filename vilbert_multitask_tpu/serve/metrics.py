@@ -1,53 +1,70 @@
-"""Serving telemetry: latency reservoir + counters + profiler hooks.
+"""Serving telemetry: latency histogram + counters + profiler hooks.
 
 Reference capability (SURVEY.md §5): observability in the reference is a
 wall-clock ``print`` per job (reference worker.py:544,657-658) and stdout
 breadcrumbs. Here a process-wide, thread-safe metrics object records
 per-request latency and per-task counters, exposed via ``GET /metrics``
-(serve/http_api.py), plus thin ``jax.profiler`` trace toggles for on-demand
-TPU traces.
+(serve/http_api.py), plus thin ``jax.profiler`` trace toggles for
+on-demand TPU traces.
+
+Latency storage and percentile math live in ``obs.instruments`` — the one
+shared :class:`~vilbert_multitask_tpu.obs.instruments.Histogram` /
+:func:`~vilbert_multitask_tpu.obs.instruments.percentile` implementation
+(linear interpolation; the old nearest-rank ``int(p * len(lat))`` here was
+upward-biased — p50 of two samples returned the max).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import Counter, deque
+from collections import Counter
 from typing import Any, Dict, Optional
+
+from vilbert_multitask_tpu.obs.instruments import Histogram, percentile
 
 
 class Metrics:
     def __init__(self, reservoir: int = 2048):
         self._lock = threading.Lock()
-        self._lat_ms: deque = deque(maxlen=reservoir)
-        self._by_task: Counter = Counter()
+        # Standalone histogram (not in obs.REGISTRY): each Metrics instance
+        # owns its samples, so tests composing several stacks don't share.
+        self._lat = Histogram("request_latency_ms",
+                              "End-to-end request latency (ms).",
+                              labelnames=("task",), reservoir=reservoir)
         self._failures: Counter = Counter()
+        # Uptime is wall-clock by definition (reported across restarts,
+        # compared against deploy timestamps) — not a duration measurement.
         self._started = time.time()
 
     def record(self, task_id: int, latency_ms: float) -> None:
-        with self._lock:
-            self._lat_ms.append(latency_ms)
-            self._by_task[task_id] += 1
+        self._lat.observe(latency_ms, task=str(task_id))
 
     def record_failure(self, task_id: Optional[int] = None) -> None:
         with self._lock:
             self._failures[task_id if task_id is not None else -1] += 1
 
+    @property
+    def latency(self) -> Histogram:
+        """The underlying histogram (Prometheus exposition reads buckets)."""
+        return self._lat
+
     def snapshot(self) -> Dict[str, Any]:
+        lat = sorted(self._lat.all_samples())
+        by_task = {task: n for (task,), n in sorted(
+            self._lat.series_counts().items(),
+            key=lambda kv: int(kv[0][0]))}
         with self._lock:
-            lat = sorted(self._lat_ms)
-            by_task = dict(self._by_task)
             failures = dict(self._failures)
 
         def pct(p: float) -> Optional[float]:
-            if not lat:
-                return None
-            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 3)
+            v = percentile(lat, p)
+            return round(v, 3) if v is not None else None
 
         return {
-            "uptime_s": round(time.time() - self._started, 1),
+            "uptime_s": round(time.time() - self._started, 1),  # vmtlint: disable=VMT109 — uptime is wall-clock, not a latency
             "requests": sum(by_task.values()),
-            "by_task": {str(k): v for k, v in sorted(by_task.items())},
+            "by_task": by_task,
             "failures": {str(k): v for k, v in sorted(failures.items())},
             "latency_ms": {"p50": pct(0.50), "p90": pct(0.90),
                            "p99": pct(0.99), "n": len(lat)},

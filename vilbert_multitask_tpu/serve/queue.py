@@ -92,7 +92,10 @@ class DurableQueue:
             c.execute(
                 "UPDATE jobs SET status='pending', claimed_at=NULL "
                 "WHERE queue=? AND status='inflight' AND claimed_at < ?",
-                (self.queue_name, now - self.visibility_timeout_s),
+                # Deadline math on persisted wall-clock stamps: claimed_at is
+                # written by (possibly) another process, so a monotonic clock
+                # cannot be compared against it.
+                (self.queue_name, now - self.visibility_timeout_s),  # vmtlint: disable=VMT109
             )
             # Jobs that crash the whole worker never reach nack(); without
             # this, a timed-out claim would redeliver them forever.
@@ -180,7 +183,8 @@ class DurableQueue:
 
 def make_job_message(image_paths, question: str, task_id: int,
                      socket_id: str, *,
-                     collect_attention: "bool | str" = False
+                     collect_attention: "bool | str" = False,
+                     trace_id: "str | None" = None
                      ) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
     list of absolute paths, ``question`` the (pre-lowercased) query.
@@ -201,4 +205,8 @@ def make_job_message(image_paths, question: str, task_id: int,
     }
     if collect_attention:
         msg["collect_attention"] = collect_attention
+    if trace_id:
+        # Cross-thread span correlation: the worker re-enters this trace
+        # (obs.trace_scope) so submit → claim → infer → push share one id.
+        msg["trace_id"] = trace_id
     return msg

@@ -19,6 +19,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
+from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import ServingConfig, TASK_REGISTRY
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 from vilbert_multitask_tpu.serve.db import ResultStore
@@ -137,25 +138,47 @@ class ServeWorker:
 
     def process_job(self, job: Job) -> Dict[str, Any]:
         """One message end-to-end; raises on failure (caller nacks)."""
-        qa_id, prepared, t0 = self._intake(job)
-        # collect_attention: falsy → none; truthy → summary in the result
-        # frame; the string "full" additionally persists every per-bridge
-        # per-head map (save_full_attention).
-        collect = job.body.get("collect_attention", False)
-        out, result = self.engine.run(prepared,
-                                      collect_attention=bool(collect))
-        attention = None
-        if collect:
-            attention = _attention_summary(out)
-            if collect == "full":
-                attention.update(save_full_attention(
-                    out, qa_id, self.serving.media_root))
-        return self._finish_job(job, qa_id, prepared, result, t0,
-                                attention=attention)
+        # Re-enter the trace minted at HTTP submit (queue.make_job_message
+        # carried the id across the thread boundary); jobs published by
+        # pre-tracing clients get a fresh id (trace_scope(None)).
+        with obs.trace_scope(job.body.get("trace_id")), \
+                obs.span("worker.job", job_id=job.id,
+                         task_id=job.body.get("task_id", "")):
+            with obs.span("worker.intake"):
+                qa_id, prepared, t0 = self._intake(job)
+            # collect_attention: falsy → none; truthy → summary in the result
+            # frame; the string "full" additionally persists every per-bridge
+            # per-head map (save_full_attention).
+            collect = job.body.get("collect_attention", False)
+            with obs.span("worker.infer",
+                          task_id=job.body.get("task_id", "")):
+                out, result = self.engine.run(prepared,
+                                              collect_attention=bool(collect))
+            attention = None
+            if collect:
+                attention = _attention_summary(out)
+                if collect == "full":
+                    attention.update(save_full_attention(
+                        out, qa_id, self.serving.media_root))
+            return self._finish_job(job, qa_id, prepared, result, t0,
+                                    attention=attention)
+
+    def _claim(self, exclude=()) -> Optional[Job]:
+        """Claim with telemetry: the claim interval only becomes a span if a
+        job came back (idle polls must not churn the span ring), and it
+        joins the claimed job's trace after the fact (record_span)."""
+        t0 = time.perf_counter()
+        job = self.queue.claim(exclude=exclude)
+        if job is not None:
+            obs.default_tracer().record_span(
+                "worker.claim", t0, time.perf_counter() - t0,
+                trace_id=job.body.get("trace_id"), job_id=job.id,
+                attempts=job.attempts)
+        return job
 
     def step(self) -> Optional[str]:
         """Claim and run one job. Returns 'acked'/'failed'/None."""
-        job = self.queue.claim()
+        job = self._claim()
         if job is None:
             return None
         return self.step_one(job)
@@ -188,7 +211,7 @@ class ServeWorker:
         done = 0
         failed_ids: set = set()
         while len(packable) < max_jobs:
-            job = self.queue.claim(exclude=failed_ids)
+            job = self._claim(exclude=failed_ids)
             if job is None:
                 break
             if job.body.get("collect_attention"):
@@ -199,7 +222,12 @@ class ServeWorker:
                     failed_ids.add(job.id)  # don't spin its attempts away
                 continue
             try:
-                qa_id, prepared, t0 = self._intake(job)
+                # Per-job trace scope: intake spans join the trace each job
+                # carried from its own HTTP submit.
+                with obs.trace_scope(job.body.get("trace_id")), \
+                        obs.span("worker.intake", job_id=job.id,
+                                 task_id=job.body.get("task_id", "")):
+                    qa_id, prepared, t0 = self._intake(job)
                 packable.append((job, qa_id, prepared, t0))
             except Exception:
                 self._fail_job(job)
@@ -207,14 +235,31 @@ class ServeWorker:
         if not packable:
             return done
         try:
-            results = self.engine.run_many([p for _, _, p, _ in packable])
+            # One span for the shared batched forward: it serves many
+            # traces at once, so it stands alone (its own trace id) with
+            # the member jobs recorded as an attribute.
+            t_fwd = time.perf_counter()
+            with obs.span("worker.batch_forward", n_jobs=len(packable),
+                          job_ids=[j.id for j, _, _, _ in packable]):
+                results = self.engine.run_many(
+                    [p for _, _, p, _ in packable])
+            # ...and the same window attributed into each member's trace,
+            # so a request's waterfall stays contiguous under batching.
+            dur_fwd = time.perf_counter() - t_fwd
+            for job, _, p, _ in packable:
+                obs.default_tracer().record_span(
+                    "worker.infer", t_fwd, dur_fwd,
+                    trace_id=job.body.get("trace_id"), job_id=job.id,
+                    task_id=p.spec.task_id, batched=True,
+                    n_jobs=len(packable))
         except Exception:
             for job, _, _, _ in packable:
                 self._fail_job(job)
             return done
         for (job, qa_id, prepared, t0), result in zip(packable, results):
             try:
-                self._finish_job(job, qa_id, prepared, result, t0)
+                with obs.trace_scope(job.body.get("trace_id")):
+                    self._finish_job(job, qa_id, prepared, result, t0)
                 self.queue.ack(job.id)
                 done += 1
             except Exception:
@@ -260,13 +305,16 @@ class ServeWorker:
                         (self.serving.refer_expr_dir, os.path.basename(p)))
                     for p in answer_images
                 ]
-        self.store.save_answer(qa_id, payload, answer_images)
+        with obs.span("worker.persist", qa_id=qa_id,
+                      task_id=req.spec.task_id):
+            self.store.save_answer(qa_id, payload, answer_images)
         elapsed_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.record(req.spec.task_id, elapsed_ms)
-        log_to_terminal(self.hub, socket_id, {"result": payload})
-        log_to_terminal(
-            self.hub, socket_id,
-            {"terminal": f"Task completed in {elapsed_ms:.0f} ms"})
+        with obs.span("worker.push", task_id=req.spec.task_id):
+            log_to_terminal(self.hub, socket_id, {"result": payload})
+            log_to_terminal(
+                self.hub, socket_id,
+                {"terminal": f"Task completed in {elapsed_ms:.0f} ms"})
         return payload
 
     def _fail_job(self, job: Job) -> str:

@@ -39,6 +39,7 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import FrameworkConfig
 from vilbert_multitask_tpu.train.losses import LossConfig
 from vilbert_multitask_tpu.train.step import (
@@ -710,9 +711,12 @@ class Trainer:
         ctx = self.mesh if self.mesh is not None else contextlib.nullcontext()
         with ctx:
             for step in range(start, lp.total_steps):
-                head, batch = self.sampler.next(lp.batch_size, step)
-                batch = self._place_batch(batch)
-                self.state, metrics = self._step_for(head)(self.state, batch)
+                with obs.span("train.data", step=step):
+                    head, batch = self.sampler.next(lp.batch_size, step)
+                    batch = self._place_batch(batch)
+                with obs.span("train.step", step=step, head=head):
+                    self.state, metrics = self._step_for(head)(self.state,
+                                                               batch)
                 now = step + 1
                 if now % lp.log_every == 0 or now == lp.total_steps:
                     m = {k: round(float(v), 5)
@@ -748,7 +752,8 @@ class Trainer:
                         raise FloatingPointError(
                             f"non-finite loss at step {now} (head {head}); "
                             f"snapshot NOT written")
-                    self._save(now)
+                    with obs.span("train.checkpoint", step=now):
+                        self._save(now)
         return last_metrics
 
 

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Single local CI entry point: static analysis + the fast test profile.
+#
+#     scripts/check.sh            # vmtlint (JSON) + tier-1 pytest
+#     scripts/check.sh --lint     # vmtlint only (sub-second, AST-only)
+#
+# Exits non-zero if EITHER gate fails. The lint gate runs first because
+# it is ~4 s against the whole repo and catches the classes of bug the
+# test tier can't see on CPU (host transfers inside jit, donation
+# escapes, lock-discipline races, layer violations).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== vmtlint (whole-program, strict) =="
+# --strict: warnings gate too, and stale baseline entries fail — debt
+# that got paid must leave vmtlint_baseline.json (use --prune-baseline).
+python -m vilbert_multitask_tpu.analysis --strict --format json || fail=1
+
+if [[ "${1:-}" == "--lint" ]]; then
+  exit "$fail"
+fi
+
+echo "== tier-1 tests (fast profile) =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider || fail=1
+
+exit "$fail"

@@ -108,6 +108,30 @@ def pytest_collection_modifyitems(config, items):
             f"{sorted(missing)}")
 
 
+# ------------------------------------------------- transfer-guard sanitizer
+# Dynamic twin of vmtlint's VMT101 (host-transfer-in-jit): the engine and
+# model unit tests run under ``jax.transfer_guard("disallow")``, so any
+# IMPLICIT host↔device transfer — a numpy array silently re-uploaded per
+# call, a Python scalar materialized mid-eager-forward — fails the test
+# instead of becoming round 2's 23.7 s p50. Explicit transfers
+# (``jax.device_put``, ``jnp.asarray``, ``np.asarray(device_array)``) stay
+# legal under "disallow"; that is exactly the contract the engine code is
+# held to. Session fixtures (the shared ``engine``) are built before the
+# function-scoped guard activates, so one-time boot transfers are exempt —
+# engines constructed inside a test body run fully guarded.
+TRANSFER_GUARDED_MODULES = {"test_engine", "test_model_shapes"}
+
+
+@pytest.fixture(autouse=True)
+def _no_implicit_transfers(request):
+    if request.module.__name__.rpartition(".")[2] \
+            not in TRANSFER_GUARDED_MODULES:
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield
+
+
 @pytest.fixture(scope="session")
 def tiny_config():
     from vilbert_multitask_tpu.config import ViLBertConfig

@@ -1,0 +1,469 @@
+"""Whole-program vmtlint suite: the project graph and every rule that
+needs it.
+
+Same contract as test_analysis.py — each rule proves it fires on the
+minimal hazard AND stays quiet on the correct twin — but the fixtures
+here are multi-module dicts fed to ``analyze_project``, because the
+hazards only exist across files: a numpy helper traced from a jit in
+another module, a donating function that escapes through an import, a
+thread started in one method racing a field written in another.
+"""
+
+import ast
+import json
+import textwrap
+
+import pytest
+
+from vilbert_multitask_tpu.analysis import ProjectGraph, analyze_project
+from vilbert_multitask_tpu.analysis.cli import main as cli_main
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.graph import module_name_for
+
+
+def project(sources, layers=()):
+    """Build a ProjectGraph from {rel_path: source} (dedented)."""
+    ctxs = []
+    for path in sorted(sources):
+        src = textwrap.dedent(sources[path])
+        ctxs.append(ModuleContext(path, src, ast.parse(src)))
+    graph = ProjectGraph(ctxs, layers=layers)
+    for c in ctxs:
+        c.project = graph
+    return graph
+
+
+def findings(sources, layers=()):
+    return analyze_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        library_roots=("pkg", "vilbert_multitask_tpu"), layers=layers)
+
+
+def rules_hit(sources, layers=()):
+    return {(f.rule, f.path) for f in findings(sources, layers=layers)}
+
+
+# ------------------------------------------------------------ graph builder
+def test_module_name_for_paths():
+    assert module_name_for("pkg/sub/mod.py") == "pkg.sub.mod"
+    assert module_name_for("pkg/__init__.py") == "pkg"
+    assert module_name_for("pkg/sub/__init__.py") == "pkg.sub"
+    assert module_name_for("script.py") == "script"
+
+
+def test_resolve_through_aliased_import():
+    g = project({
+        "pkg/a.py": """
+        def f():
+            return 1
+        """,
+        "pkg/b.py": """
+        from pkg.a import f as renamed
+        import pkg.a as amod
+        """,
+    })
+    b = g.modules["pkg.b"]
+    assert b.refs["renamed"] == "pkg.a.f"
+    mod, sym = g.resolve_symbol("pkg.a.f")
+    assert mod.name == "pkg.a" and sym == "f"
+    mod, sym = g.resolve_symbol(b.refs["amod"])
+    assert mod.name == "pkg.a" and sym == ""
+
+
+def test_resolve_chases_package_reexport():
+    # from pkg import f  → pkg/__init__.py → pkg/impl.py, two hops.
+    g = project({
+        "pkg/__init__.py": """
+        from pkg.impl import f
+        """,
+        "pkg/impl.py": """
+        def f():
+            return 1
+        """,
+        "app.py": """
+        from pkg import f
+        """,
+    })
+    app = g.modules["app"]
+    mod, sym = g.resolve_symbol(app.refs["f"])
+    assert mod.name == "pkg.impl" and sym == "f"
+
+
+def test_relative_imports_resolve():
+    g = project({
+        "pkg/__init__.py": "",
+        "pkg/sub/__init__.py": "",
+        "pkg/sub/a.py": """
+        from . import b
+        from .b import f
+        from ..top import g
+        """,
+        "pkg/sub/b.py": """
+        def f():
+            return 1
+        """,
+        "pkg/top.py": """
+        def g():
+            return 2
+        """,
+    })
+    a = g.modules["pkg.sub.a"]
+    assert a.refs["f"] == "pkg.sub.b.f"
+    assert a.refs["g"] == "pkg.top.g"
+    mod, sym = g.resolve_symbol(a.refs["f"])
+    assert mod.name == "pkg.sub.b" and sym == "f"
+
+
+def test_import_cycle_resolution_terminates():
+    # a re-exports from b, b re-exports from a: chasing the phantom name
+    # must return None, not recurse forever.
+    g = project({
+        "pkg/a.py": """
+        from pkg.b import ghost
+        """,
+        "pkg/b.py": """
+        from pkg.a import ghost
+        """,
+    })
+    assert g.resolve_symbol("pkg.a.ghost") is None
+    assert g.resolve_symbol("pkg.b.ghost") is None
+
+
+# ---------------------------------------------- interprocedural VMT101/103
+def test_vmt101_fires_in_helper_called_from_jit_across_modules():
+    hits = rules_hit({
+        "pkg/helpers.py": """
+        import numpy as np
+
+        def to_host(x):
+            return np.asarray(x)
+        """,
+        "pkg/model.py": """
+        import jax
+
+        from pkg.helpers import to_host
+
+        @jax.jit
+        def step(x):
+            return to_host(x) + 1
+        """,
+    })
+    # The finding lands in the helper's file — that's where the fix goes.
+    assert ("VMT101", "pkg/helpers.py") in hits
+
+
+def test_vmt101_quiet_when_helper_only_called_eagerly():
+    hits = rules_hit({
+        "pkg/helpers.py": """
+        import numpy as np
+
+        def to_host(x):
+            return np.asarray(x)
+        """,
+        "pkg/model.py": """
+        from pkg.helpers import to_host
+
+        def eager_path(x):
+            return to_host(x)
+        """,
+    })
+    assert not {r for r, _ in hits} & {"VMT101"}
+
+
+def test_vmt103_donated_buffer_escapes_through_import():
+    hits = rules_hit({
+        "pkg/steps.py": """
+        import jax
+
+        def _step(state, batch):
+            return state
+
+        train_step = jax.jit(_step, donate_argnums=(0,))
+        """,
+        "pkg/loop.py": """
+        from pkg.steps import train_step
+
+        def run(state, batches):
+            for batch in batches:
+                train_step(state, batch)  # state never rebound
+            return state
+        """,
+    })
+    assert ("VMT103", "pkg/loop.py") in hits
+
+
+def test_vmt103_quiet_when_caller_rebinds():
+    hits = rules_hit({
+        "pkg/steps.py": """
+        import jax
+
+        def _step(state, batch):
+            return state
+
+        train_step = jax.jit(_step, donate_argnums=(0,))
+        """,
+        "pkg/loop.py": """
+        from pkg.steps import train_step
+
+        def run(state, batches):
+            for batch in batches:
+                state = train_step(state, batch)
+            return state
+        """,
+    })
+    assert not {r for r, _ in hits} & {"VMT103"}
+
+
+# --------------------------------------------------------------- VMT110
+def test_vmt110_unguarded_write_in_threaded_class():
+    hits = rules_hit({
+        "pkg/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def start(self):
+                threading.Thread(target=self._refresh).start()
+
+            def _refresh(self):
+                self._data.clear()  # racing put(): no lock
+
+            def put(self, key, value):
+                with self._lock:
+                    self._data[key] = value
+        """,
+    })
+    assert ("VMT110", "pkg/cache.py") in hits
+
+
+def test_vmt110_clean_when_every_write_is_guarded():
+    hits = rules_hit({
+        "pkg/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def start(self):
+                threading.Thread(target=self._refresh).start()
+
+            def _refresh(self):
+                with self._lock:
+                    self._data.clear()
+
+            def put(self, key, value):
+                with self._lock:
+                    self._data[key] = value
+
+            def size(self):
+                return len(self._data)  # lock-free read: allowed
+        """,
+    })
+    assert not {r for r, _ in hits} & {"VMT110"}
+
+
+def test_vmt110_quiet_without_thread_witness():
+    # Same unguarded write, but nothing in the project ever runs the class
+    # on a thread — single-threaded use of a lock-holding class is not a
+    # race.
+    hits = rules_hit({
+        "pkg/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def clear(self):
+                self._data.clear()
+
+            def put(self, key, value):
+                with self._lock:
+                    self._data[key] = value
+        """,
+    })
+    assert not {r for r, _ in hits} & {"VMT110"}
+
+
+def test_vmt110_sees_threads_started_in_another_module():
+    # The thread entry lives in app.py; the racy class lives in cache.py.
+    hits = rules_hit({
+        "pkg/cache.py": """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = {}
+
+            def refresh(self):
+                self._data.clear()
+
+            def put(self, key, value):
+                with self._lock:
+                    self._data[key] = value
+        """,
+        "pkg/app.py": """
+        import threading
+
+        from pkg.cache import Cache
+
+        def serve():
+            cache = Cache()
+            threading.Thread(target=cache.refresh).start()
+            return cache
+        """,
+    })
+    assert ("VMT110", "pkg/cache.py") in hits
+
+
+# --------------------------------------------------------------- VMT111
+def test_vmt111_unknown_axis_in_partition_spec():
+    hits = rules_hit({
+        "pkg/mesh.py": """
+        import jax
+        from jax.sharding import Mesh
+
+        def build(devices):
+            return Mesh(devices, ("dp", "tp"))
+        """,
+        "pkg/specs.py": """
+        from jax.sharding import PartitionSpec
+
+        KERNEL = PartitionSpec(None, "model")
+        """,
+    })
+    assert ("VMT111", "pkg/specs.py") in hits
+
+
+def test_vmt111_clean_with_declared_axes_and_without_any_mesh():
+    clean = {
+        "pkg/mesh.py": """
+        from jax.sharding import Mesh
+
+        def build(devices):
+            return Mesh(devices, ("dp", "tp"))
+        """,
+        "pkg/specs.py": """
+        from jax.sharding import PartitionSpec
+
+        KERNEL = PartitionSpec(None, "tp")
+        ROWS = PartitionSpec("dp")
+        """,
+    }
+    assert not {r for r, _ in rules_hit(clean)} & {"VMT111"}
+    # No Mesh anywhere → no declared axes → the rule stays silent rather
+    # than flagging every spec in a repo that doesn't use meshes.
+    no_mesh = {"pkg/specs.py": clean["pkg/specs.py"]}
+    assert not {r for r, _ in rules_hit(no_mesh)} & {"VMT111"}
+
+
+# --------------------------------------------------------------- VMT112
+def test_vmt112_layer_contract_catches_lazy_import():
+    contract = (("pkg.models", "pkg.serve"),)
+    hits = rules_hit({
+        "pkg/models/net.py": """
+        def forward(x):
+            from pkg.serve.metrics import record  # lazy doesn't hide it
+            record(x)
+            return x
+        """,
+        "pkg/serve/metrics.py": """
+        def record(x):
+            pass
+        """,
+    }, layers=contract)
+    assert ("VMT112", "pkg/models/net.py") in hits
+
+
+def test_vmt112_clean_for_allowed_direction():
+    contract = (("pkg.models", "pkg.serve"),)
+    hits = rules_hit({
+        "pkg/models/net.py": """
+        def forward(x):
+            return x
+        """,
+        "pkg/serve/api.py": """
+        from pkg.models.net import forward  # serve → models is the point
+        """,
+    }, layers=contract)
+    assert not {r for r, _ in hits} & {"VMT112"}
+
+
+# ------------------------------------------------------------------- CLI
+@pytest.fixture()
+def lint_repo(tmp_path, monkeypatch):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+    [tool.vmtlint]
+    paths = ["pkg"]
+    library_roots = ["pkg"]
+    baseline = "baseline.json"
+    """))
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return np.asarray(x)
+    """))
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_cli_prune_baseline_drops_only_stale_entries(lint_repo, capsys):
+    assert cli_main(["--write-baseline", "baseline.json"]) == 0
+    capsys.readouterr()
+    # Fix the finding: its entry is now stale and strict mode says so.
+    (lint_repo / "pkg" / "bad.py").write_text("def f(x):\n    return x\n")
+    assert cli_main(["--strict"]) == 1
+    capsys.readouterr()
+    assert cli_main(["--prune-baseline"]) == 0
+    assert "pruned 1 stale baseline entry" in capsys.readouterr().err
+    doc = json.loads((lint_repo / "baseline.json").read_text())
+    assert doc["entries"] == []
+    assert cli_main(["--strict"]) == 0
+    # Nothing stale on a second prune; still exit 0 (idempotent).
+    capsys.readouterr()
+    assert cli_main(["--prune-baseline"]) == 0
+
+
+def test_cli_prune_baseline_requires_a_baseline(tmp_path, monkeypatch,
+                                               capsys):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.vmtlint]\npaths = [\"pkg\"]\n")
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "ok.py").write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    assert cli_main(["--prune-baseline"]) == 2  # usage error, not silence
+    capsys.readouterr()
+
+
+def test_cli_sarif_output(lint_repo, capsys):
+    assert cli_main(["--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "vmtlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"VMT101", "VMT110", "VMT112"} <= rule_ids
+    results = run["results"]
+    assert any(r["ruleId"] == "VMT101" for r in results)
+    hit = next(r for r in results if r["ruleId"] == "VMT101")
+    assert hit["locations"][0]["physicalLocation"]["artifactLocation"][
+        "uri"] == "pkg/bad.py"
+    assert "vmtlint/v1" in hit["partialFingerprints"]
+    # Baselined findings are suppressed, not SARIF results.
+    assert cli_main(["--write-baseline", "baseline.json"]) == 0
+    capsys.readouterr()
+    assert cli_main(["--format", "sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []

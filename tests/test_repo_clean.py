@@ -23,9 +23,11 @@ def _scan():
     assert root == REPO_ROOT, "pyproject.toml with [tool.vmtlint] not found"
     paths = [os.path.join(root, p) for p in cfg.paths]
     findings = analyze_paths(paths, root=root,
-                             rules=default_rules(cfg.severity),
+                             rules=default_rules(cfg.severity,
+                                                 cfg.rule_paths),
                              exclude=cfg.exclude,
-                             library_roots=cfg.library_roots)
+                             library_roots=cfg.library_roots,
+                             layers=cfg.layers)
     baseline = {}
     if cfg.baseline:
         baseline = bl.load_baseline(os.path.join(root, cfg.baseline))
@@ -65,3 +67,23 @@ def test_baseline_entries_carry_justification():
     missing = [fp for fp, e in baseline.items()
                if not str(e.get("justification", "")).strip()]
     assert not missing, f"baseline entries lack a justification: {missing}"
+
+
+def test_whole_program_rules_active_and_scan_covers_tests():
+    # The project-graph rule family must stay registered, the layering
+    # contracts declared, and tests/ inside the scan set — otherwise the
+    # "whole repo is race/layer clean" guarantee quietly narrows.
+    cfg, _root = load_config(REPO_ROOT)
+    ids = {r.id for r in default_rules()}
+    assert {"VMT110", "VMT111", "VMT112"} <= ids
+    assert cfg.layers, "[tool.vmtlint.layers] contracts disappeared"
+    assert any(p == "tests" or p.startswith("tests/") for p in cfg.paths)
+
+
+def test_layer_contracts_protect_the_analysis_package():
+    # analysis/ is the tool itself: it must stay importable without jax
+    # (tier-1 lint gating runs before any backend exists). The contract is
+    # only as good as its presence in config.
+    cfg, _root = load_config(REPO_ROOT)
+    assert ("vilbert_multitask_tpu.analysis", "jax") in [
+        tuple(c) for c in cfg.layers]

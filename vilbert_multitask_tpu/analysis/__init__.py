@@ -23,6 +23,8 @@ from vilbert_multitask_tpu.analysis.core import (  # noqa: F401
     Rule,
     analyze_file,
     analyze_paths,
+    analyze_project,
     analyze_source,
 )
+from vilbert_multitask_tpu.analysis.graph import ProjectGraph  # noqa: F401
 from vilbert_multitask_tpu.analysis.rules import RULES  # noqa: F401

@@ -59,6 +59,21 @@ def write_baseline(path: str, findings: Sequence[Finding],
         fh.write("\n")
 
 
+def prune_baseline(path: str, stale_fingerprints: Sequence[str]) -> None:
+    """Rewrite the baseline file without the given stale entries, keeping
+    every surviving entry byte-identical (justifications included). The
+    baseline only ever shrinks — growth goes through --write-baseline
+    plus a human-authored justification."""
+    stale = set(stale_fingerprints)
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    data["entries"] = [e for e in data.get("entries", [])
+                       if e.get("fingerprint") not in stale]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
 def split_baselined(findings: Sequence[Finding], baseline: Dict[str, dict]
                     ) -> Tuple[List[Finding], List[Finding], List[str]]:
     """(new, baselined, stale_fingerprints). Stale entries — baseline rows

@@ -1,0 +1,354 @@
+"""Call graph over the project graph: who calls whom, statically.
+
+Nodes are every function in every scanned module — top-level functions,
+methods, and nested functions — addressed as ``module:Outer.inner``.
+Edges are calls and bare references (a function handed to ``jax.lax.scan``
+or ``Thread(target=...)`` is reached without a call expression). Only
+statically resolvable targets make edges: ``name`` through local scopes
+then imports, ``self.m`` through the lexically enclosing class,
+``Class.m`` through the module symbol table, dotted paths through
+:meth:`ProjectGraph.resolve_symbol`. Dynamic dispatch (``obj.method`` on
+an unknown object) makes no edge — the analyses built on top are
+deliberately under-approximate everywhere except thread-entry naming,
+which falls back to terminal-name matching (see ``_entry_candidates``).
+
+Three fixed points live here:
+
+- ``traced``: functions reachable from any jit body inherit traced
+  context (interprocedural VMT101/102/103), each with a witness chain;
+- ``donations``: a function's parameter is donated if it flows into a
+  ``donate_argnums`` position of a jitted binding or of another donating
+  function (donated-buffer escape across call edges, VMT103);
+- ``thread_reachable``: functions reachable from thread entry points
+  (``threading.Thread(target=...)``, executor ``submit``/``map``,
+  ``BaseHTTPRequestHandler`` do_* verbs, ``threading.Thread`` run
+  overrides) — the evidence side of the VMT110 race detector.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class FuncNode:
+    qualname: str  # "module:scope.path"
+    module: object  # ModuleInfo
+    node: ast.AST  # the FunctionDef
+    scope: Tuple[str, ...]  # lexical path inside the module
+    cls_scope: Tuple[str, ...]  # path up to the innermost class ("" = none)
+    # Outgoing edges, (callee qualname, is_call); refs count for
+    # reachability (traced / thread) but not for donation positions.
+    edges: List[Tuple[str, bool]] = dataclasses.field(default_factory=list)
+
+
+class CallGraph:
+    def __init__(self, project):
+        self.project = project
+        self.functions: Dict[str, FuncNode] = {}
+        self.by_node: Dict[int, FuncNode] = {}
+        for mod in project.modules.values():
+            self._index_module(mod)
+        for fn in self.functions.values():
+            fn.edges = list(self._edges_for(fn))
+        self.traced: Dict[str, str] = self._propagate_traced()
+        self.donations: Dict[str, Set[int]] = self._propagate_donations()
+        self.thread_reachable: Dict[str, str] = self._propagate_threads()
+
+    # ------------------------------------------------------------ indexing
+    def _index_module(self, mod) -> None:
+        def visit(node: ast.AST, scope: Tuple[str, ...],
+                  cls: Tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _SCOPES):
+                    sub = scope + (child.name,)
+                    fn = FuncNode(f"{mod.name}:{'.'.join(sub)}",
+                                  mod, child, sub, cls)
+                    self.functions[fn.qualname] = fn
+                    self.by_node[id(child)] = fn
+                    visit(child, sub, cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, scope + (child.name,),
+                          scope + (child.name,))
+                else:
+                    visit(child, scope, cls)
+
+        visit(mod.ctx.tree, (), ())
+
+    def _own_nodes(self, fn_body: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function body without descending into nested function or
+        class scopes (those are their own graph nodes)."""
+        stack = list(ast.iter_child_nodes(fn_body))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _SCOPES + (ast.ClassDef,)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # ---------------------------------------------------------- resolution
+    def resolve_callable(self, mod, expr: ast.AST,
+                         scope: Tuple[str, ...] = (),
+                         cls_scope: Tuple[str, ...] = ()
+                         ) -> Optional[str]:
+        """Qualname of the project function ``expr`` denotes, or None."""
+        if isinstance(expr, ast.Name):
+            # Innermost enclosing scope outward: nested sibling functions
+            # shadow module-level ones shadow imports.
+            for i in range(len(scope), -1, -1):
+                qual = f"{mod.name}:{'.'.join(scope[:i] + (expr.id,))}"
+                if qual in self.functions:
+                    return qual
+            target = mod.refs.get(expr.id)
+            if target:
+                return self._resolve_dotted(target)
+            return None
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self" and cls_scope):
+                qual = (f"{mod.name}:"
+                        f"{'.'.join(cls_scope + (expr.attr,))}")
+                if qual in self.functions:
+                    return qual
+                return None
+            dotted = mod.ctx.resolve(expr)
+            if not dotted:
+                return None
+            head = dotted.split(".")[0]
+            if head in mod.symbols:  # Class.method in this module
+                qual = f"{mod.name}:{dotted}"
+                return qual if qual in self.functions else None
+            return self._resolve_dotted(dotted)
+        return None
+
+    def _resolve_dotted(self, dotted: str) -> Optional[str]:
+        resolved = self.project.resolve_symbol(dotted)
+        if resolved is None:
+            return None
+        tmod, sym = resolved
+        if not sym:
+            return None
+        qual = f"{tmod.name}:{sym}"
+        return qual if qual in self.functions else None
+
+    def _edges_for(self, fn: FuncNode
+                   ) -> Iterator[Tuple[str, bool]]:
+        seen: Set[Tuple[str, bool]] = set()
+        for node in self._own_nodes(fn.node):
+            target: Optional[str] = None
+            is_call = False
+            if isinstance(node, ast.Call):
+                target = self.resolve_callable(
+                    fn.module, node.func, fn.scope, fn.cls_scope)
+                is_call = True
+            elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                parent = fn.module.ctx.parent(node)
+                if isinstance(parent, ast.Call) and parent.func is node:
+                    continue  # the Call case above owns call positions
+                target = self.resolve_callable(
+                    fn.module, node, fn.scope, fn.cls_scope)
+            if target and (target, is_call) not in seen:
+                seen.add((target, is_call))
+                yield target, is_call
+
+    # ------------------------------------------------------------- traced
+    def _seed_edges(self, mod, body: ast.AST, scope: Tuple[str, ...],
+                    cls_scope: Tuple[str, ...]) -> Iterator[str]:
+        """Resolvable callables used anywhere inside a jit body (including
+        its nested functions — everything lexically inside is traced)."""
+        for node in ast.walk(body):
+            if isinstance(node, ast.Call):
+                t = self.resolve_callable(mod, node.func, scope, cls_scope)
+                if t:
+                    yield t
+            elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                parent = mod.ctx.parent(node)
+                if isinstance(parent, ast.Call) and parent.func is node:
+                    continue
+                t = self.resolve_callable(mod, node, scope, cls_scope)
+                if t:
+                    yield t
+
+    def _propagate_traced(self) -> Dict[str, str]:
+        traced: Dict[str, str] = {}
+        frontier: List[str] = []
+        for mod in self.project.modules.values():
+            for info in mod.ctx.jit_bodies:
+                fn = self.by_node.get(id(info.body))
+                scope = fn.scope if fn else ()
+                cls = fn.cls_scope if fn else ()
+                label = fn.qualname if fn else f"{mod.name}:<lambda>"
+                for target in self._seed_edges(mod, info.body, scope, cls):
+                    if target not in traced and target != label:
+                        traced[target] = f"jitted `{label}`"
+                        frontier.append(target)
+        while frontier:
+            qual = frontier.pop()
+            for target, _ in self.functions[qual].edges:
+                if target not in traced:
+                    traced[target] = f"{traced[qual]} -> `{qual}`"
+                    frontier.append(target)
+        return traced
+
+    def traced_in(self, mod) -> List[Tuple[FuncNode, str]]:
+        return sorted(
+            ((self.functions[q], w) for q, w in self.traced.items()
+             if self.functions[q].module is mod),
+            key=lambda fw: fw[0].qualname)
+
+    # ---------------------------------------------------------- donations
+    def _param_index(self, fn: FuncNode, name: str) -> Optional[int]:
+        params = [a.arg for a in fn.node.args.args]
+        return params.index(name) if name in params else None
+
+    def _propagate_donations(self) -> Dict[str, Set[int]]:
+        """Fixed point: param i of f is donated if some call inside f
+        passes it in a donating position of a jitted binding or of a
+        function already known to donate that position. Restricted to
+        module-level functions — method donation would need self-offset
+        bookkeeping for no current payoff."""
+        donations: Dict[str, Set[int]] = {}
+        toplevel = [fn for fn in self.functions.values()
+                    if len(fn.scope) == 1 and not fn.cls_scope]
+        changed = True
+        while changed:
+            changed = False
+            for fn in toplevel:
+                mine = donations.setdefault(fn.qualname, set())
+                for node in self._own_nodes(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for pos in self._donating_positions(fn, node,
+                                                        donations):
+                        if pos >= len(node.args):
+                            continue
+                        arg = node.args[pos]
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        idx = self._param_index(fn, arg.id)
+                        if idx is not None and idx not in mine:
+                            mine.add(idx)
+                            changed = True
+        return {q: d for q, d in donations.items() if d}
+
+    def _donating_positions(self, fn: FuncNode, call: ast.Call,
+                            donations: Dict[str, Set[int]]
+                            ) -> Tuple[int, ...]:
+        if isinstance(call.func, ast.Name):
+            donate = fn.module.ctx.jit_bound_names.get(call.func.id)
+            if donate:
+                return donate
+        target = self.resolve_callable(fn.module, call.func, fn.scope,
+                                       fn.cls_scope)
+        if target and donations.get(target):
+            return tuple(sorted(donations[target]))
+        return ()
+
+    # ------------------------------------------------------------ threads
+    _THREAD_VERB_BASES = {"BaseHTTPRequestHandler",
+                          "SimpleHTTPRequestHandler",
+                          "http.server.BaseHTTPRequestHandler",
+                          "http.server.SimpleHTTPRequestHandler"}
+
+    def _entry_candidates(self) -> Iterator[Tuple[str, str]]:
+        """(qualname, entry description) for every thread entry point."""
+        for mod in self.project.modules.values():
+            ctx = mod.ctx
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    yield from self._call_entries(mod, node)
+                elif isinstance(node, ast.ClassDef):
+                    yield from self._class_entries(mod, node)
+
+    def _lexical_scope(self, mod, node: ast.AST
+                       ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+        fn = mod.ctx.enclosing_function(node)
+        owner = self.by_node.get(id(fn)) if fn is not None else None
+        if owner is not None:
+            return owner.scope, owner.cls_scope
+        return (), ()
+
+    def _call_entries(self, mod, call: ast.Call
+                      ) -> Iterator[Tuple[str, str]]:
+        ctx = mod.ctx
+        resolved = ctx.resolve(call.func)
+        targets: List[ast.AST] = []
+        how = ""
+        if resolved in ("threading.Thread", "threading.Timer"):
+            targets = [kw.value for kw in call.keywords
+                       if kw.arg in ("target", "function")]
+            how = "threading.Thread(target=...)"
+        elif isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr == "submit" and call.args:
+                targets, how = [call.args[0]], "executor.submit"
+            elif (attr == "map" and call.args
+                    and isinstance(call.func.value, ast.Name)
+                    and any(s in call.func.value.id.lower()
+                            for s in ("pool", "executor"))):
+                targets, how = [call.args[0]], "executor.map"
+        scope, cls = self._lexical_scope(mod, call)
+        for t in targets:
+            qual = self.resolve_callable(mod, t, scope, cls)
+            if qual:
+                yield qual, how
+            elif isinstance(t, ast.Attribute):
+                # `Thread(target=self.worker.run_forever)`: the receiver
+                # type is unknown statically — fall back to matching the
+                # terminal method name project-wide. Over-approximate by
+                # design: missing a thread entry hides races.
+                for fn in self.functions.values():
+                    if fn.scope[-1] == t.attr and fn.cls_scope:
+                        yield fn.qualname, f"{how} (by name `{t.attr}`)"
+
+    def _class_entries(self, mod, cls: ast.ClassDef
+                       ) -> Iterator[Tuple[str, str]]:
+        bases = {mod.ctx.resolve(b) for b in cls.bases}
+        handler = bases & self._THREAD_VERB_BASES
+        thread_sub = "threading.Thread" in bases
+        if not (handler or thread_sub):
+            return
+        for stmt in cls.body:
+            if not isinstance(stmt, _SCOPES):
+                continue
+            fn = self.by_node.get(id(stmt))
+            if fn is None:
+                continue
+            if handler and stmt.name.startswith("do_"):
+                yield fn.qualname, f"{next(iter(handler))}.{stmt.name}"
+            if thread_sub and stmt.name == "run":
+                yield fn.qualname, "threading.Thread subclass run()"
+
+    def _propagate_threads(self) -> Dict[str, str]:
+        reachable: Dict[str, str] = {}
+        frontier: List[str] = []
+        for qual, how in self._entry_candidates():
+            if qual not in reachable:
+                reachable[qual] = how
+                frontier.append(qual)
+        while frontier:
+            qual = frontier.pop()
+            for target, _ in self.functions[qual].edges:
+                if target not in reachable:
+                    reachable[target] = f"{reachable[qual]} -> `{qual}`"
+                    frontier.append(target)
+        return reachable
+
+    def class_thread_witness(self, mod, cls_node: ast.ClassDef
+                             ) -> Optional[str]:
+        path: List[str] = [cls_node.name]
+        for anc in mod.ctx.ancestors(cls_node):
+            if isinstance(anc, _SCOPES + (ast.ClassDef,)):
+                path.insert(0, anc.name)
+        cls_scope = tuple(path)
+        for fn in self.functions.values():
+            if (fn.module is mod and fn.cls_scope == cls_scope
+                    and fn.qualname in self.thread_reachable):
+                return self.thread_reachable[fn.qualname]
+        return None

@@ -37,8 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignore any configured baseline")
     p.add_argument("--write-baseline", default=None, metavar="FILE",
                    help="write current findings as a new baseline and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline dropping stale entries for "
+                        "scanned files (keeps justifications) and exit 0")
+    p.add_argument("--format", default=None, dest="fmt",
+                   choices=("human", "json", "sarif"),
+                   help="output format (default: human)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit one JSON document instead of human lines")
+                   help="alias for --format json")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
     return p
@@ -62,10 +68,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    rules = default_rules(cfg.severity)
+    rules = default_rules(cfg.severity, cfg.rule_paths)
     findings = analyze_paths(paths, root=root, rules=rules,
                              exclude=cfg.exclude,
-                             library_roots=cfg.library_roots)
+                             library_roots=cfg.library_roots,
+                             layers=cfg.layers)
     scanned = {
         os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
         for p in iter_python_files(paths, exclude=cfg.exclude)}
@@ -100,7 +107,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # entries for files outside it.
     stale = [fp for fp in stale
              if baseline[fp].get("path") in scanned]
-    render = report.render_json if args.as_json else report.render_human
+
+    if args.prune_baseline:
+        if not baseline_path or not os.path.exists(baseline_path):
+            print("vmtlint: --prune-baseline needs an existing baseline",
+                  file=sys.stderr)
+            return 2
+        bl.prune_baseline(baseline_path, stale)
+        noun = "entry" if len(stale) == 1 else "entries"
+        print(f"vmtlint: pruned {len(stale)} stale baseline {noun} from "
+              f"{baseline_path}", file=sys.stderr)
+        return 0
+
+    fmt = args.fmt or ("json" if args.as_json else "human")
+    render = {"human": report.render_human, "json": report.render_json,
+              "sarif": report.render_sarif}[fmt]
     out = render(new, baselined, stale, files_scanned)
     if out:
         print(out)

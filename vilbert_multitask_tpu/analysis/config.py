@@ -32,6 +32,12 @@ class VmtlintConfig:
     fail_on: str = "error"
     # Per-rule severity overrides: {"VMT105": "error", ...}
     severity: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Layering contracts ([tool.vmtlint.layers] forbid = ["A -> B", ...]):
+    # modules under prefix A must not import modules under prefix B.
+    layers: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+    # Per-rule path exclusions ([tool.vmtlint.rule_paths]): rel-path
+    # prefixes a rule skips — {"VMT107": ["tests"], ...}.
+    rule_paths: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
 
 
 _SECTION_RE = re.compile(r"^\s*\[([^\]]+)\]\s*$")
@@ -154,4 +160,25 @@ def load_config(start: str = ".") -> Tuple[VmtlintConfig, Optional[str]]:
     sev = tables.get("tool.vmtlint.severity", {})
     cfg.severity = {k: str(v) for k, v in sev.items()
                     if v in ("error", "warning")}
+    layers = tables.get("tool.vmtlint.layers", {}).get("forbid")
+    if isinstance(layers, list):
+        cfg.layers = [c for c in (parse_layer_contract(str(v))
+                                  for v in layers) if c is not None]
+    for key, val in tables.get("tool.vmtlint.rule_paths", {}).items():
+        if isinstance(val, list):
+            cfg.rule_paths[key] = [str(v) for v in val]
     return cfg, os.path.dirname(pyproject)
+
+
+def parse_layer_contract(spec: str) -> Optional[Tuple[str, str]]:
+    """``"pkg.models -> pkg.serve"`` → ("pkg.models", "pkg.serve").
+    Path-style prefixes (``pkg/models``) are normalized to dotted form."""
+    if "->" not in spec:
+        return None
+    src, _, dst = spec.partition("->")
+
+    def norm(s: str) -> str:
+        return s.strip().strip("/").replace("/", ".")
+
+    src, dst = norm(src), norm(dst)
+    return (src, dst) if src and dst else None

@@ -41,6 +41,10 @@ class ModuleContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        # Set by the driver once all modules are parsed: the ProjectGraph
+        # this module belongs to (analysis/graph.py). Even single-file
+        # analysis gets a one-module project, so rules can rely on it.
+        self.project = None
         self.aliases: Dict[str, str] = {}
         self._parents: Dict[int, ast.AST] = {}
         for parent in ast.walk(tree):

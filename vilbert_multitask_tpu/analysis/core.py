@@ -49,16 +49,26 @@ class Rule:
     # e.g. the stray-print rule only polices library code, not scripts.
     library_only: bool = False
 
-    def __init__(self, severity: Optional[str] = None):
+    def __init__(self, severity: Optional[str] = None,
+                 not_under: Sequence[str] = ()):
         if severity is not None:
             self.severity = severity
+        # Per-repo path gating ([tool.vmtlint.rule_paths]): rel-path
+        # prefixes this rule instance skips — how the widened tests/
+        # scripts/ scan keeps library-grade rules out of test idioms.
+        self.not_under: Sequence[str] = tuple(not_under)
 
     def applies_to(self, ctx: ModuleContext, library_roots: Sequence[str]
                    ) -> bool:
+        def under(rel: str, prefix: str) -> bool:
+            prefix = prefix.rstrip("/")
+            return rel == prefix or rel.startswith(prefix + "/")
+
+        if any(under(ctx.rel_path, p) for p in self.not_under):
+            return False
         if not self.library_only:
             return True
-        return any(ctx.rel_path.startswith(root.rstrip("/") + "/")
-                   for root in library_roots)
+        return any(under(ctx.rel_path, root) for root in library_roots)
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -102,31 +112,58 @@ def is_suppressed(finding: Finding, suppressions: Dict[int, Set[str]]
 
 
 # ----------------------------------------------------------------- driver
-def analyze_source(source: str, rel_path: str = "<string>",
-                   rules: Optional[Sequence[Rule]] = None,
-                   library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
-                   ) -> List[Finding]:
-    """Analyze one module's source. Returns unsuppressed findings sorted by
-    (path, line, rule). Syntax errors yield a single VMT000 error — an
-    unparseable file must fail loudly, not pass silently."""
+def analyze_project(sources: Dict[str, str],
+                    rules: Optional[Sequence[Rule]] = None,
+                    library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
+                    layers: Sequence = (),
+                    ) -> List[Finding]:
+    """Whole-program analysis over {rel_path: source}. All modules are
+    parsed first, joined into one ProjectGraph (import graph, symbol
+    tables, call graph), and only then checked — so rules see cross-module
+    facts: helpers traced from jit in *other* files, imported donating
+    functions, thread entries, project-wide mesh axes, layer contracts.
+
+    Syntax errors yield a single VMT000 error for that file — an
+    unparseable file must fail loudly, not pass silently — and exclude it
+    from the project graph."""
+    from vilbert_multitask_tpu.analysis.graph import ProjectGraph
+
     if rules is None:
         from vilbert_multitask_tpu.analysis.rules import default_rules
 
         rules = default_rules()
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Finding(rule="VMT000", name="syntax-error", severity="error",
-                        path=rel_path, line=e.lineno or 1, col=e.offset or 1,
-                        message=f"file does not parse: {e.msg}",
-                        content=(e.text or "").strip())]
-    ctx = ModuleContext(rel_path, source, tree)
-    sup = suppressions_for(source)
-    findings = [
-        f for rule in rules if rule.applies_to(ctx, library_roots)
-        for f in rule.check(ctx) if not is_suppressed(f, sup)
-    ]
+    findings: List[Finding] = []
+    ctxs: List[ModuleContext] = []
+    for rel_path in sorted(sources):
+        source = sources[rel_path]
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="VMT000", name="syntax-error", severity="error",
+                path=rel_path, line=e.lineno or 1, col=e.offset or 1,
+                message=f"file does not parse: {e.msg}",
+                content=(e.text or "").strip()))
+            continue
+        ctxs.append(ModuleContext(rel_path, source, tree))
+    project = ProjectGraph(ctxs, layers=layers)
+    for ctx in ctxs:
+        ctx.project = project
+        sup = suppressions_for(ctx.source)
+        findings.extend(
+            f for rule in rules if rule.applies_to(ctx, library_roots)
+            for f in rule.check(ctx) if not is_suppressed(f, sup))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_source(source: str, rel_path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None,
+                   library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
+                   ) -> List[Finding]:
+    """Analyze one module's source (as a one-module project). Returns
+    unsuppressed findings sorted by (path, line, rule)."""
+    return analyze_project({rel_path: source}, rules=rules,
+                           library_roots=library_roots)
 
 
 def analyze_file(path: str, root: str = ".",
@@ -169,9 +206,15 @@ def analyze_paths(paths: Sequence[str], root: str = ".",
                   rules: Optional[Sequence[Rule]] = None,
                   exclude: Sequence[str] = (),
                   library_roots: Sequence[str] = ("vilbert_multitask_tpu",),
+                  layers: Sequence = (),
                   ) -> List[Finding]:
-    out: List[Finding] = []
+    """Scan files/dirs as ONE project: every scanned module joins the same
+    import/call graph, so cross-file rules see the full picture."""
+    sources: Dict[str, str] = {}
     for path in iter_python_files(paths, exclude=exclude):
-        out.extend(analyze_file(path, root=root, rules=rules,
-                                library_roots=library_roots))
-    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+        rel = os.path.relpath(os.path.abspath(path),
+                              os.path.abspath(root)).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            sources[rel] = f.read()
+    return analyze_project(sources, rules=rules,
+                           library_roots=library_roots, layers=layers)

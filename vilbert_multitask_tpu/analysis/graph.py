@@ -1,0 +1,290 @@
+"""Project graph: import graph + per-module symbol tables, AST-only.
+
+One :class:`ProjectGraph` spans every module handed to the driver — no
+module is ever imported or executed. It gives rules the whole-program
+facts the per-module :class:`~.context.ModuleContext` cannot see:
+
+- which project module a dotted name lands in (``resolve_symbol``),
+  chasing re-exports through ``__init__`` aliases with a cycle guard;
+- every import edge (including lazy function-level imports) for the
+  VMT112 layering contracts;
+- project-wide mesh axis declarations for VMT111;
+- the call graph (``analysis/callgraph.py``) behind interprocedural jit
+  propagation (VMT101/102/103 in helpers called *from* jit) and the
+  VMT110 thread-entry reachability.
+
+Even a single-file ``analyze_source`` run builds a one-module project, so
+rules never branch on "is there a project" — the graph just has fewer
+modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from vilbert_multitask_tpu.analysis.context import JitInfo, ModuleContext
+
+_MESH_CALLS = {"jax.sharding.Mesh", "jax.experimental.maps.Mesh"}
+
+
+def module_name_for(rel_path: str) -> str:
+    """Dotted module name for a repo-relative path.
+
+    ``pkg/sub/mod.py`` → ``pkg.sub.mod``; ``pkg/__init__.py`` → ``pkg``.
+    Directories without ``__init__.py`` (scripts/, tests/) still get a
+    dotted name — layering contracts match on these prefixes.
+    """
+    path = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    parts = [p for p in path.replace("\\", "/").split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or rel_path
+
+
+@dataclasses.dataclass
+class ImportRecord:
+    """One import statement edge: the canonical module imported, plus the
+    symbol for ``from M import y`` (which may itself be a submodule)."""
+
+    module: str  # canonical dotted module ("" if unresolvable relative)
+    symbol: str  # "" for plain `import M`
+    node: ast.AST  # for line attribution
+
+    def targets(self) -> Tuple[str, ...]:
+        """Dotted names this record may bind — layering matches any."""
+        if self.symbol:
+            return (self.module, f"{self.module}.{self.symbol}")
+        return (self.module,)
+
+
+class ModuleInfo:
+    """One module's project-level view: symbols, imports, canonical refs."""
+
+    def __init__(self, name: str, ctx: ModuleContext, is_package: bool):
+        self.name = name
+        self.ctx = ctx
+        self.is_package = is_package
+        # Top-level definitions (functions, classes, assigned names).
+        self.symbols: Dict[str, ast.AST] = {}
+        # Every import in the module, lazy function-level ones included.
+        self.imports: List[ImportRecord] = []
+        # Local name -> canonical dotted target, with relative imports
+        # resolved against the package (ModuleContext.aliases keeps the
+        # raw spelling; this map is what project resolution trusts).
+        self.refs: Dict[str, str] = {}
+        self._collect()
+
+    def _package(self) -> List[str]:
+        parts = self.name.split(".")
+        return parts if self.is_package else parts[:-1]
+
+    def _collect(self) -> None:
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self.symbols[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.symbols[t.id] = stmt
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                self.symbols[stmt.target.id] = stmt
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports.append(ImportRecord(a.name, "", node))
+                    self.refs[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports.append(ImportRecord(base, a.name, node))
+                    if base:
+                        self.refs[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _from_base(self, node: ast.ImportFrom) -> str:
+        """Canonical module of a ``from`` import, resolving relativity:
+        in package ``a.b``, ``from .x import y`` has base ``a.b.x``."""
+        if not node.level:
+            return node.module or ""
+        pkg = self._package()
+        anchor = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 else pkg
+        parts = anchor + (node.module.split(".") if node.module else [])
+        return ".".join(parts)
+
+
+class ProjectGraph:
+    """All scanned modules plus the cross-module lookup tables."""
+
+    def __init__(self, contexts: Sequence[ModuleContext],
+                 layers: Sequence[Tuple[str, str]] = ()):
+        self.layers: List[Tuple[str, str]] = list(layers)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        for ctx in contexts:
+            name = module_name_for(ctx.rel_path)
+            info = ModuleInfo(name, ctx,
+                              ctx.rel_path.endswith("__init__.py"))
+            self.modules[name] = info
+            self.by_path[ctx.rel_path] = info
+        self._callgraph = None
+        self._mesh_axes: Optional[Set[str]] = None
+
+    def module(self, ctx: ModuleContext) -> Optional[ModuleInfo]:
+        return self.by_path.get(ctx.rel_path)
+
+    # --------------------------------------------------------- resolution
+    def resolve_symbol(self, dotted: str,
+                       _seen: Optional[Set[Tuple[str, str]]] = None
+                       ) -> Optional[Tuple[ModuleInfo, str]]:
+        """(module, symbol_path) for a canonical dotted name, or None if it
+        doesn't land in a scanned module. ``symbol_path`` is "" when the
+        name IS the module; re-export chains through package ``__init__``
+        are followed with a cycle guard."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            if prefix in self.modules:
+                return self._resolve_in(self.modules[prefix], parts[i:],
+                                        _seen or set())
+        return None
+
+    def _resolve_in(self, mod: ModuleInfo, remainder: List[str],
+                    seen: Set[Tuple[str, str]]
+                    ) -> Optional[Tuple[ModuleInfo, str]]:
+        if not remainder:
+            return mod, ""
+        head = remainder[0]
+        if head in mod.symbols:
+            return mod, ".".join(remainder)
+        key = (mod.name, head)
+        if key in seen:  # import cycle / re-export loop
+            return None
+        seen.add(key)
+        target = mod.refs.get(head)
+        if target:
+            return self.resolve_symbol(
+                ".".join([target] + remainder[1:]), seen)
+        sub = f"{mod.name}.{head}"
+        if sub in self.modules:
+            return self._resolve_in(self.modules[sub], remainder[1:], seen)
+        return None
+
+    # ---------------------------------------------------------- callgraph
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from vilbert_multitask_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    def traced_helpers(self, ctx: ModuleContext
+                       ) -> List[Tuple[JitInfo, str]]:
+        """Functions in this module that inherit traced context by being
+        reachable (calls or references) from some jit body, wrapped as
+        :class:`JitInfo` so the lexical rules apply unchanged, each with a
+        human-readable witness chain. Functions lexically inside a jit
+        body are excluded — the lexical pass already covers them."""
+        mod = self.module(ctx)
+        if mod is None:
+            return []
+        jit_ids = {id(info.body) for info in ctx.jit_bodies}
+        out: List[Tuple[JitInfo, str]] = []
+        for fn, witness in self.callgraph.traced_in(mod):
+            if id(fn.node) in jit_ids:
+                continue
+            if any(id(anc) in jit_ids for anc in ctx.ancestors(fn.node)):
+                continue
+            out.append((JitInfo(fn.node), witness))
+        return out
+
+    def local_donors(self, ctx: ModuleContext) -> Dict[str, Tuple[int, ...]]:
+        """Names visible in this module that donate arguments — module-level
+        functions whose params are (transitively) donated, plus imported
+        aliases of such functions in other modules."""
+        mod = self.module(ctx)
+        if mod is None:
+            return {}
+        donations = self.callgraph.donations
+        out: Dict[str, Tuple[int, ...]] = {}
+        for name in mod.symbols:
+            qual = f"{mod.name}:{name}"
+            if donations.get(qual):
+                out[name] = tuple(sorted(donations[qual]))
+        for alias, target in mod.refs.items():
+            resolved = self.resolve_symbol(target)
+            if resolved is None:
+                continue
+            tmod, sym = resolved
+            donate = donations.get(f"{tmod.name}:{sym}") if sym else None
+            if donate:
+                out[alias] = tuple(sorted(donate))
+            elif sym and sym in tmod.ctx.jit_bound_names:
+                # f = jax.jit(g, donate_argnums=...) re-exported by name.
+                d = tmod.ctx.jit_bound_names[sym]
+                if d:
+                    out[alias] = d
+        return out
+
+    def thread_witness(self, ctx: ModuleContext, cls_node: ast.ClassDef
+                       ) -> Optional[str]:
+        """If any function belonging to this class runs on a thread (is a
+        thread entry point or is call-reachable from one), the entry's
+        qualname — the evidence VMT110 attaches to a race finding."""
+        mod = self.module(ctx)
+        if mod is None:
+            return None
+        return self.callgraph.class_thread_witness(mod, cls_node)
+
+    # -------------------------------------------------------------- mesh
+    def mesh_axes(self) -> Set[str]:
+        """Every mesh axis name declared anywhere in the project: string
+        constants in ``Mesh(...)`` axis arguments and in ``axis_names``
+        assignments/defaults/keywords. The union is deliberately generous
+        — a missing declaration causes false positives, never the
+        reverse."""
+        if self._mesh_axes is not None:
+            return self._mesh_axes
+        axes: Set[str] = set()
+        for mod in self.modules.values():
+            axes |= module_mesh_axes(mod.ctx)
+        self._mesh_axes = axes
+        return axes
+
+
+def module_mesh_axes(ctx: ModuleContext) -> Set[str]:
+    axes: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and ctx.resolve(node.func) in _MESH_CALLS):
+            cands = list(node.args[1:2])
+            cands += [kw.value for kw in node.keywords
+                      if kw.arg in ("axis_names", "axis_name")]
+            for cand in cands:
+                axes |= _str_constants(cand)
+        elif (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "axis_names"
+                        for t in node.targets)):
+            axes |= _str_constants(node.value)
+        elif (isinstance(node, ast.AnnAssign) and node.value is not None
+                and isinstance(node.target, ast.Name)
+                and node.target.id == "axis_names"):
+            axes |= _str_constants(node.value)
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes |= _str_constants(kw.value)
+    return axes
+
+
+def _str_constants(node: ast.AST) -> Set[str]:
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}

@@ -45,3 +45,47 @@ def render_json(new: Sequence[Finding], baselined: Sequence[Finding],
         "counts": _counts(new),
         "files_scanned": files_scanned,
     }, indent=2)
+
+
+_SARIF_LEVEL = {"error": "error", "warning": "warning"}
+
+
+def render_sarif(new: Sequence[Finding], baselined: Sequence[Finding],
+                 stale: Sequence[str], files_scanned: int) -> str:
+    """Minimal SARIF 2.1.0 for editor/CI integration. Only unsuppressed,
+    non-baselined findings become results — the baseline is this tool's
+    suppression store, so re-surfacing grandfathered rows in an IDE would
+    undo it."""
+    from vilbert_multitask_tpu.analysis.rules import RULES
+
+    rules_meta = [{
+        "id": cls.id,
+        "name": cls.name,
+        "shortDescription": {"text": cls.description},
+        "defaultConfiguration": {
+            "level": _SARIF_LEVEL.get(cls.severity, "warning")},
+    } for cls in RULES]
+    results = [{
+        "ruleId": f.rule,
+        "level": _SARIF_LEVEL.get(f.severity, "warning"),
+        "message": {"text": f.message},
+        "partialFingerprints": {"vmtlint/v1": f.fingerprint()},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line, "startColumn": f.col},
+            },
+        }],
+    } for f in new]
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "vmtlint",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }, indent=2)

@@ -47,11 +47,18 @@ class HostTransferInJit(Rule):
     severity = "error"
     description = ("host-transfer call (np.asarray/np.array/.item()/"
                    ".tolist()/float()/jax.device_get) inside a "
-                   "jit/pjit-compiled function")
+                   "jit/pjit-compiled function, or in a helper reached "
+                   "from one through the call graph")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         seen: Set[int] = set()
-        for info in ctx.jit_bodies:
+        # Lexical jit bodies first, then helpers the project call graph
+        # proves reachable from some jit body (possibly in another
+        # module) — those inherit traced context wholesale.
+        sources: List[Tuple] = [(info, None) for info in ctx.jit_bodies]
+        if ctx.project is not None:
+            sources += ctx.project.traced_helpers(ctx)
+        for info, witness in sources:
             body = info.body
             # Trace-time-static names (static_argnames/nums params, shape
             # tuple unpacks): host math on them is a compile-time constant
@@ -65,6 +72,9 @@ class HostTransferInJit(Rule):
                     seen.add(id(node))
                     f = self._check_call(ctx, node, static)
                     if f is not None:
+                        if witness:
+                            f.message += (f" [in a helper reached from "
+                                          f"{witness}]")
                         yield f
 
     def _check_call(self, ctx: ModuleContext, call: ast.Call,
@@ -133,6 +143,25 @@ class RecompileTrigger(Rule):
                             f"defined inside a loop — each iteration "
                             f"creates and compiles a new callable")
         yield from self._unhashable_statics(ctx)
+        yield from self._jit_in_traced_helper(ctx)
+
+    def _jit_in_traced_helper(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """A helper reached from a jit body that builds a fresh jitted
+        callable: the inner callable is recreated every outer trace, so
+        its compile cache never hits."""
+        if ctx.project is None:
+            return
+        for info, witness in ctx.project.traced_helpers(ctx):
+            for node in ast.walk(info.body):
+                if (isinstance(node, ast.Call)
+                        and ctx.is_jit_entry(node.func)
+                        and not ctx.in_loop(node, stop_at_function=False)):
+                    yield self.finding(
+                        ctx, node, f"jax.jit built inside "
+                        f"`{getattr(info.body, 'name', '<lambda>')}`, "
+                        f"which is reached from {witness} — the callable "
+                        f"(and its compile cache entry) is recreated on "
+                        f"every call; hoist the jitted function out")
 
     def _unhashable_statics(self, ctx: ModuleContext) -> Iterator[Finding]:
         # static positions per locally-jitted name, from the jit call site.
@@ -182,17 +211,26 @@ class DonatedBufferReuse(Rule):
                    "donate_argnums position")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # Names that donate when called: lexically-jitted bindings in this
+        # module, widened by the project graph to imported jitted
+        # functions and wrappers whose params are transitively donated
+        # (donated-buffer escape across call edges).
+        donors: Dict[str, Tuple[int, ...]] = {}
+        if ctx.project is not None:
+            donors.update(ctx.project.local_donors(ctx))
+        donors.update(ctx.jit_bound_names)
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield from self._check_block(ctx, node.body)
+                yield from self._check_block(ctx, node.body, donors)
 
-    def _donating_calls(self, ctx: ModuleContext, stmt: ast.stmt
+    def _donating_calls(self, ctx: ModuleContext, stmt: ast.stmt,
+                        donors: Dict[str, Tuple[int, ...]]
                         ) -> Iterator[Tuple[ast.Call, List[str]]]:
         for node in ast.walk(stmt):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Name)):
                 continue
-            donate = ctx.jit_bound_names.get(node.func.id)
+            donate = donors.get(node.func.id)
             if not donate:
                 continue
             names = [node.args[i].id for i in donate
@@ -210,7 +248,8 @@ class DonatedBufferReuse(Rule):
                 out.add(node.id)
         return out
 
-    def _check_block(self, ctx: ModuleContext, block: List[ast.stmt]
+    def _check_block(self, ctx: ModuleContext, block: List[ast.stmt],
+                     donors: Dict[str, Tuple[int, ...]]
                      ) -> Iterator[Finding]:
         donated: Dict[str, int] = {}  # name -> line it was donated on
         for stmt in block:
@@ -225,7 +264,7 @@ class DonatedBufferReuse(Rule):
                         f"its device buffer no longer exists — rebind the "
                         f"result or drop the donation")
                     donated.pop(node.id)
-            for call, names in self._donating_calls(ctx, stmt):
+            for call, names in self._donating_calls(ctx, stmt, donors):
                 for n in names:
                     donated[n] = call.lineno
             for n in self._bound_names(stmt):
@@ -237,7 +276,8 @@ class DonatedBufferReuse(Rule):
                 for inner in stmt.body:
                     rebound |= self._bound_names(inner)
                 for inner in stmt.body:
-                    for call, names in self._donating_calls(ctx, inner):
+                    for call, names in self._donating_calls(ctx, inner,
+                                                            donors):
                         for n in names:
                             if n not in rebound:
                                 yield self.finding(
@@ -643,15 +683,288 @@ class WallClockDuration(Rule):
                     "justification if this really is calendar math)")
 
 
+# --------------------------------------------------------------------- 110
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition")
+_INIT_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+# self.field.<method>() calls that mutate the container in place.
+_CONTAINER_MUTATORS = {"append", "extend", "insert", "add", "remove",
+                       "discard", "pop", "popitem", "clear", "update",
+                       "setdefault", "appendleft", "popleft"}
+
+
+class _ClassLockAnalysis:
+    """Per-class lock-discipline facts: which fields the lock guards, and
+    which accesses happen outside it."""
+
+    def __init__(self, ctx: ModuleContext, cls: ast.ClassDef):
+        self.ctx = ctx
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            s.name: s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.locks: Set[str] = self._find_locks()
+        # (field, node, method, lexically_guarded, is_write)
+        self.accesses: List[Tuple[str, ast.AST, str, bool, bool]] = []
+        self.locked_only: Set[str] = set()
+        if self.locks:
+            self._collect_accesses()
+            self._infer_locked_only()
+
+    def _find_locks(self) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(self.cls):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and self.ctx.resolve(node.value.func) in _LOCK_CTORS):
+                out.update(t.attr for t in node.targets
+                           if isinstance(t, ast.Attribute)
+                           and isinstance(t.value, ast.Name)
+                           and t.value.id == "self")
+        return out
+
+    def _lexically_guarded(self, node: ast.AST) -> bool:
+        """Inside ``with self.<lock>:`` — stopping at function boundaries,
+        because a nested def inside a with-block escapes the lock."""
+        for anc in self.ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    e = item.context_expr
+                    if (isinstance(e, ast.Attribute)
+                            and isinstance(e.value, ast.Name)
+                            and e.value.id == "self"
+                            and e.attr in self.locks):
+                        return True
+        return False
+
+    def _is_write(self, node: ast.Attribute) -> bool:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        parent = self.ctx.parent(node)
+        if (isinstance(parent, ast.Subscript) and parent.value is node
+                and isinstance(parent.ctx, (ast.Store, ast.Del))):
+            return True  # self.d[k] = v
+        if (isinstance(parent, ast.Attribute) and parent.value is node
+                and isinstance(parent.ctx, (ast.Store, ast.Del))):
+            return True  # self.obj.attr = v
+        if (isinstance(parent, ast.Attribute) and parent.value is node
+                and parent.attr in _CONTAINER_MUTATORS):
+            gp = self.ctx.parent(parent)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                return True  # self.d.clear() / self.xs.append(...)
+        return False
+
+    def _collect_accesses(self) -> None:
+        for mname, method in self.methods.items():
+            for node in ast.walk(method):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr not in self.locks):
+                    continue
+                self.accesses.append((
+                    node.attr, node, mname,
+                    self._lexically_guarded(node), self._is_write(node)))
+
+    def _infer_locked_only(self) -> None:
+        """Private methods whose every intra-class call site holds the
+        lock are themselves lock-guarded (the ``_degrade_to_xla`` /
+        ``Histogram._get_series`` pattern). Fixed point so helpers called
+        only from locked helpers qualify. __init__ call sites count as
+        guarded — construction is single-threaded."""
+        sites: Dict[str, List[Tuple[str, bool]]] = {}
+        for mname, method in self.methods.items():
+            for node in ast.walk(method):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in self.methods):
+                    continue
+                sites.setdefault(node.func.attr, []).append(
+                    (mname, self._lexically_guarded(node)))
+        changed = True
+        while changed:
+            changed = False
+            for m, callers in sites.items():
+                if (m in self.locked_only or not m.startswith("_")
+                        or m.startswith("__")):
+                    continue
+                if all(guarded or c in self.locked_only
+                       or c in _INIT_METHODS for c, guarded in callers):
+                    self.locked_only.add(m)
+                    changed = True
+
+    def guarded_fields(self) -> Set[str]:
+        """Fields the lock demonstrably protects: written at least once
+        under it (lexically or in a locked-only method), outside
+        construction. Read-only-under-lock fields don't qualify — that
+        pattern is usually immutability, not lock discipline."""
+        return {field for field, _n, m, guarded, write in self.accesses
+                if write and m not in _INIT_METHODS
+                and (guarded or m in self.locked_only)}
+
+    def unguarded_writes(self, guarded: Set[str]
+                         ) -> Iterator[Tuple[str, ast.AST, str]]:
+        for field, node, m, lex, write in self.accesses:
+            if (write and field in guarded and m not in _INIT_METHODS
+                    and not lex and m not in self.locked_only):
+                yield field, node, m
+
+
+class LockDisciplineRace(Rule):
+    """A lock-guarded field written without the lock in a class that runs
+    on threads.
+
+    Per class: infer the guarded-field set (fields written under ``with
+    self.<lock>`` or inside methods only ever called with the lock held),
+    then flag writes that skip the lock — but only when the project call
+    graph shows the class actually executes on a thread (a
+    ``Thread(target=...)``, executor ``submit``/``map``, HTTP handler
+    verb, or anything call-reachable from one). Unguarded *reads* are not
+    flagged: lock-free reads of a generation counter or stats snapshot
+    are a deliberate, benign pattern in this codebase.
+    """
+
+    id = "VMT110"
+    name = "unlocked-shared-field"
+    severity = "error"
+    description = ("field written without the lock that guards its other "
+                   "writes, in a class reachable from a thread entry "
+                   "point")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassLockAnalysis(ctx, node)
+            if not info.locks:
+                continue
+            guarded = info.guarded_fields()
+            if not guarded:
+                continue
+            witness = ctx.project.thread_witness(ctx, node)
+            if witness is None:
+                continue
+            lock = sorted(info.locks)[0]
+            for field, acc, method in info.unguarded_writes(guarded):
+                yield self.finding(
+                    ctx, acc, f"`self.{field}` is written in "
+                    f"`{node.name}.{method}` without `self.{lock}`, but "
+                    f"its other writes hold the lock; `{node.name}` runs "
+                    f"on threads ({witness}) — this is a data race: take "
+                    f"the lock here or suppress with a justification")
+
+
+# --------------------------------------------------------------------- 111
+class PartitionSpecAxisMismatch(Rule):
+    """PartitionSpec axis name matching no declared mesh axis.
+
+    Collects every mesh axis declared anywhere in the project — string
+    constants in ``jax.sharding.Mesh(...)`` axis arguments and in
+    ``axis_names`` assignments/defaults/keywords (``parallel/mesh.py``,
+    ``config.py``) — then validates the constant-string axes of every
+    ``PartitionSpec(...)`` call against that set. A typo'd axis fails at
+    runtime only on the multi-host path that actually builds the mesh;
+    statically it's just a string comparison. Variable axis arguments are
+    skipped; a project declaring no axes is silent.
+    """
+
+    id = "VMT111"
+    name = "partition-spec-axis"
+    severity = "error"
+    description = ("PartitionSpec uses an axis name not declared by any "
+                   "mesh in the project")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        from vilbert_multitask_tpu.analysis.graph import module_mesh_axes
+
+        declared = (ctx.project.mesh_axes() if ctx.project is not None
+                    else module_mesh_axes(ctx))
+        if not declared:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and ctx.resolve(node.func)
+                    == "jax.sharding.PartitionSpec"):
+                continue
+            for arg in node.args:
+                for const in ast.walk(arg):
+                    if (isinstance(const, ast.Constant)
+                            and isinstance(const.value, str)
+                            and const.value not in declared):
+                        yield self.finding(
+                            ctx, const, f"PartitionSpec axis "
+                            f"`{const.value}` is not declared by any mesh "
+                            f"in the project (declared: "
+                            f"{', '.join(sorted(declared))}) — a typo'd "
+                            f"axis only fails at runtime on the mesh "
+                            f"path")
+
+
+# --------------------------------------------------------------------- 112
+class LayeringViolation(Rule):
+    """Import that breaks a declared layering contract.
+
+    Contracts live in ``[tool.vmtlint.layers]`` in pyproject.toml as
+    ``forbid = ["pkg.models -> pkg.serve", ...]`` — dotted module-prefix
+    pairs meaning "modules under the left prefix must not import modules
+    under the right". Checked against every import in the module,
+    including lazy function-level ones (a lazy import still couples the
+    layers at runtime).
+    """
+
+    id = "VMT112"
+    name = "layering-violation"
+    severity = "error"
+    description = ("import forbidden by a [tool.vmtlint.layers] contract")
+
+    @staticmethod
+    def _under(name: str, prefix: str) -> bool:
+        return name == prefix or name.startswith(prefix + ".")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        project = ctx.project
+        if project is None or not project.layers:
+            return
+        mod = project.module(ctx)
+        if mod is None:
+            return
+        seen: Set[Tuple[int, str]] = set()
+        for src, dst in project.layers:
+            if not self._under(mod.name, src):
+                continue
+            for imp in mod.imports:
+                if not any(self._under(t, dst) for t in imp.targets()):
+                    continue
+                key = (getattr(imp.node, "lineno", 0), dst)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, imp.node, f"import of `{imp.targets()[-1]}` "
+                    f"breaks the layering contract `{src} -> {dst}` "
+                    f"declared in [tool.vmtlint.layers] — this layer "
+                    f"must not depend on that one")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
-         SwallowedException, ModuleLevelNumpyMutation, WallClockDuration]
+         SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
+         LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation]
 
 
-def default_rules(severity_overrides: Optional[Dict[str, str]] = None
+def default_rules(severity_overrides: Optional[Dict[str, str]] = None,
+                  rule_paths: Optional[Dict[str, Sequence[str]]] = None,
                   ) -> List[Rule]:
-    """Instantiate the registry, applying per-repo severity overrides
-    (keys may be rule ids or names)."""
+    """Instantiate the registry, applying per-repo severity overrides and
+    per-rule path exclusions (keys may be rule ids or names)."""
     over = {k.lower(): v for k, v in (severity_overrides or {}).items()}
-    return [cls(severity=over.get(cls.id.lower(), over.get(cls.name.lower())))
+    gates = {k.lower(): v for k, v in (rule_paths or {}).items()}
+    return [cls(severity=over.get(cls.id.lower(), over.get(cls.name.lower())),
+                not_under=gates.get(cls.id.lower(),
+                                    gates.get(cls.name.lower(), ())))
             for cls in RULES]

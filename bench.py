@@ -32,6 +32,15 @@ Env knobs:
 - ``BENCH_PROBE=0`` skip the pre-attempt backend probe (default ON for the
   hardware path; TINY mode never probes). ``BENCH_PROBE_TIMEOUT_S`` (240),
   ``BENCH_PROBE_BACKOFF_S`` (45) tune the probe cycle.
+- ``BENCH_PROBE_WINDOW_S`` (300) dead-tunnel fast-fail: if the backend has
+  NEVER answered a probe by this deadline, emit a partial JSON line (with a
+  ``last_known_good`` pointer at the newest committed BENCH artifact) and
+  exit — ~5 minutes of evidence instead of burning the whole wall budget
+  probing a tunnel that was down from the start. Once any probe succeeds
+  the window is disarmed; later flakiness gets the full budget.
+- ``BENCH_ANATOMY_REPS`` (20) reps for the post-headline latency-anatomy
+  probes (dispatch floor / many-arg execute / host round-trip — see
+  ``_anatomy_probes``); ``BENCH_ANATOMY=0`` skips the stage.
 - ``BENCH_SWEEP_ROWS`` comma-separated extra run_many chunk sizes (e.g.
   ``64,128``) to time alongside the configured buckets — the chunk-size
   knee finder for an execute-bound backend (round-5 hardware showed p50
@@ -335,7 +344,15 @@ def _measure_throughput(engine, cfg, *, n: int = 160,
         # dispatch the others don't (n is a multiple of the biggest size,
         # so every size keeps >= half the requests).
         n_s = (n // chunk_rows) * chunk_rows
+        # The warm call pays this size's bucket compile (if the persistent
+        # cache missed); log it so sweep sizes carry their real price in
+        # the round's stderr record — "near-free qps" claims need the
+        # compile bill next to them.
+        t0 = time.perf_counter()
         engine.run_many(reqs[:chunk_rows], chunk_rows=chunk_rows)  # warm
+        warm_s = time.perf_counter() - t0
+        print(f"# chunk {chunk_rows}: warm+compile {warm_s:.1f}s",
+              file=sys.stderr)
         t0 = time.perf_counter()
         results = engine.run_many(reqs[:n_s], chunk_rows=chunk_rows)
         dt = time.perf_counter() - t0
@@ -344,7 +361,7 @@ def _measure_throughput(engine, cfg, *, n: int = 160,
         # comes from the engine (the single copy of the packing math).
         rows = engine.padded_rows([1] * n_s, chunk_rows=chunk_rows)
         tflops = serving_forward_flops(cfg.model, cfg.engine, rows) / dt / 1e12
-        return round(n_s / dt, 2), round(tflops, 4)
+        return round(n_s / dt, 2), round(tflops, 4), round(warm_s, 1)
 
     # Per-size isolation: one OOM/compile failure at a knee-finder size
     # must cost that key, not the whole throughput pass (the baseline
@@ -364,6 +381,9 @@ def _measure_throughput(engine, cfg, *, n: int = 160,
         if s != best:
             out[f"batch_qps_b{s}"] = by_size[s][0]
             out[f"batch_tflops_b{s}"] = by_size[s][1]
+        # Per-size warm+compile cost: what the sweep size actually charged
+        # this run (≈0 when the persistent compile cache hit).
+        out[f"batch_warm_s_b{s}"] = by_size[s][2]
     out.update({"batch_qps": by_size[best][0],
                 "batch_tflops": by_size[best][1],
                 "batch_chunk_rows": best})
@@ -419,6 +439,98 @@ def _measure_throughput_mixed(engine, cfg, *, groups_n: int = 8):
             "batch_mixed_n": len(reqs)}
 
 
+def _anatomy_probes(*, reps: int = 20, include_bigarg: bool = False,
+                    include_tiny: bool = False) -> dict:
+    """Latency anatomy: attribute the per-dispatch milliseconds.
+
+    Round-5 hardware showed every serving dispatch costs ~72-78 ms whether
+    the chunk is 1 row or 32, while a trivial jitted op completes in
+    ~0.03 ms. These probes separate the candidate costs so the headline p50
+    can be attributed instead of guessed at:
+
+      manyarg_exec_ms   trivial jitted fn over 192 small resident arrays —
+                        the per-ARGUMENT marshalling term (a serving forward
+                        ships the whole ~190-leaf param tree every execute).
+      roundtrip_ms      device_put of fresh host bytes + scalar fetch per
+                        rep (fresh data defeats host-copy caching) — the
+                        true host<->device RTT; on a tunneled backend this
+                        is the wire.
+      bigarg_exec_ms    (non-TINY only) trivial fn over 4 x 128 MB resident
+                        arrays — per-BYTE cost for resident args; should be
+                        ~free since only buffer handles cross the wire.
+
+    Read together with the headline's ``dispatch_floor_ms`` (timed inside
+    ``_measure``, same method): if manyarg >> floor the fix is fewer/larger
+    leaves per execute (the O(1)-leaf rows path exists for exactly this);
+    if roundtrip dominates, the latency is the tunnel's and vanishes on
+    locally-attached TPU; if neither, the p50 is genuine device time and
+    worth a ``BENCH_PROFILE_DIR`` trace. Every probe is best-effort — a
+    failure costs its own key, never the headline.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def median_ms(fn) -> float:
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return round(percentile(ts, 0.5), 3)
+
+    out: dict = {}
+    if include_tiny:
+        # One resident arg, trivial compute — the dispatch floor. The bench
+        # headline times the same probe inside ``_measure`` (as
+        # ``dispatch_floor_ms``); this flag exists for the standalone
+        # scripts/tpu_latency_anatomy.py entrypoint.
+        try:
+            tiny = jax.jit(lambda x: x + 1.0)
+            x = jax.device_put(jnp.zeros((8, 128), jnp.float32))
+            jax.block_until_ready(tiny(x))
+            out["tiny_exec_ms"] = median_ms(
+                lambda: jax.block_until_ready(tiny(x)))
+        except Exception as e:  # noqa: BLE001
+            print(f"# tiny probe failed: {e}", file=sys.stderr)
+    try:
+        leaves = [jax.device_put(jnp.full((16,), float(i), jnp.float32))
+                  for i in range(192)]
+        manyarg = jax.jit(lambda *ls: ls[0][0] + ls[-1][0])
+        jax.block_until_ready(manyarg(*leaves))  # compile outside the timing
+        out["manyarg_exec_ms"] = median_ms(
+            lambda: jax.block_until_ready(manyarg(*leaves)))
+    except Exception as e:  # noqa: BLE001 — anatomy is diagnostic, not gating
+        print(f"# manyarg probe failed: {e}", file=sys.stderr)
+
+    try:
+        counter = [0]
+
+        def rt():
+            counter[0] += 1
+            y = jax.device_put(np.array([counter[0]], np.float32))
+            assert float(y[0]) == counter[0]
+
+        rt()
+        out["roundtrip_ms"] = median_ms(rt)
+    except Exception as e:  # noqa: BLE001
+        print(f"# roundtrip probe failed: {e}", file=sys.stderr)
+
+    if include_bigarg:
+        # Serving-scale resident bytes (4 x 128 MB ≈ the f32 param tree);
+        # skipped in TINY/CPU smoke where the 512 MB allocation is all cost
+        # and no signal.
+        try:
+            big = [jax.device_put(jnp.zeros((32, 1024, 1024), jnp.float32))
+                   for _ in range(4)]
+            bigarg = jax.jit(lambda a, b, c, d: a[0, 0, 0] + d[0, 0, 0])
+            jax.block_until_ready(bigarg(*big))
+            out["bigarg_exec_ms"] = median_ms(
+                lambda: jax.block_until_ready(bigarg(*big)))
+        except Exception as e:  # noqa: BLE001
+            print(f"# bigarg probe failed: {e}", file=sys.stderr)
+    return out
+
+
 def run_measurement() -> None:
     """Child-process body: build, warm, time, print the JSON line."""
     import jax
@@ -445,6 +557,17 @@ def run_measurement() -> None:
     except Exception as e:  # noqa: BLE001 — throughput is a bonus metric
         print(f"# throughput pass failed: {e}", file=sys.stderr)
         thr = {}
+    # Post-headline anatomy stage (folded in from the old
+    # scripts/tpu_latency_anatomy.py): bounded, best-effort, runs strictly
+    # after the p50/throughput numbers are in hand.
+    anatomy = {}
+    if os.environ.get("BENCH_ANATOMY", "1") not in ("", "0"):
+        t0 = time.perf_counter()
+        anatomy = _anatomy_probes(
+            reps=int(os.environ.get("BENCH_ANATOMY_REPS", "20")),
+            include_bigarg=not TINY)
+        print(f"# anatomy stage {time.perf_counter() - t0:.1f}s: {anatomy}",
+              file=sys.stderr)
     trace_out = os.environ.get("BENCH_TRACE_OUT")
     if trace_out:
         # The engine spans recorded during _measure (tokenize / features /
@@ -466,11 +589,23 @@ def run_measurement() -> None:
         file=sys.stderr,
     )
     # MFU against the chip's peak dense bf16 rate (None off-TPU).
-    from vilbert_multitask_tpu.engine.flops import peak_flops_for
+    from vilbert_multitask_tpu.engine.flops import (
+        param_tree_bytes,
+        peak_flops_for,
+        serving_roofline,
+    )
 
     peak = peak_flops_for(device_kind)
     mfu = (round(stats["achieved_tflops_p50"] * 1e12 / peak, 5)
            if peak else None)
+    # Roofline context for the MFU numbers: every forward reads the whole
+    # param tree from HBM, so small batches are weight-read-bound and a low
+    # measured MFU can be the ROOF, not a software gap. param_bytes also
+    # records which storage dtype served (bf16 mode halves it).
+    param_bytes = param_tree_bytes(engine.params)
+    roof_batch = thr.get("batch_chunk_rows", max(stats["buckets"]))
+    roofline = serving_roofline(cfg.model, cfg.engine, roof_batch,
+                                device_kind, param_bytes)
 
     print(json.dumps({
         "metric": "p50_latency_ms",
@@ -489,6 +624,11 @@ def run_measurement() -> None:
         "decode_p50_ms": stats["decode_p50_ms"],
         "stage_ms": stats["stage_ms"],
         "dispatch_floor_ms": stats["dispatch_floor_ms"],
+        **anatomy,
+        "param_bytes": param_bytes,
+        "param_dtype": cfg.engine.param_dtype,
+        "achievable_mfu": roofline["achievable_mfu"],
+        "roofline": roofline["reason"],
         "n_queries": stats["n_queries"],
         "buckets_timed": stats["buckets"],
         "init_s": round(init_s, 1),
@@ -680,6 +820,30 @@ _STATE = {"emitted": False, "best": None, "log": [], "t0": 0.0,
           "child": None}
 
 
+def _last_known_good() -> dict:
+    """Pointer at the newest committed BENCH_*_builder.json artifact, for
+    failure emissions: a round that never got a number still tells its
+    reader where the last real one lives (and what it was), so a dead
+    tunnel doesn't read as "the engine got slow"."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cands = sorted(glob.glob(os.path.join(here, "BENCH_*_builder.json")),
+                   key=os.path.getmtime)
+    if not cands:
+        return {}
+    path = cands[-1]
+    out = {"last_known_good": os.path.basename(path)}
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        if isinstance(prev.get("value"), (int, float)):
+            out["last_known_good_p50_ms"] = prev["value"]
+    except (OSError, ValueError):
+        pass
+    return out
+
+
 def _emit_final(obj: dict) -> None:
     if _STATE["emitted"]:
         return
@@ -702,6 +866,7 @@ def _on_kill_signal(signum, frame) -> None:
             "error": (f"killed by signal {signum} after "
                       f"{time.monotonic() - _STATE['t0']:.0f}s; "
                       f"log: {' | '.join(_STATE['log'][-4:])}")[:600],
+            **_last_known_good(),
         })
     os._exit(1)
 
@@ -723,6 +888,12 @@ def main() -> None:
                 and os.environ.get("BENCH_PROBE", "1") not in ("", "0"))
     probe_timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
     probe_backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF_S", "45"))
+    # Dead-tunnel fast-fail: if the backend has NEVER answered a probe by
+    # this deadline, the tunnel was down before we started — report and get
+    # out in ~5 minutes instead of probing out the whole wall budget (the
+    # round-5 builder artifact spent 1798 s learning nothing a 5-minute
+    # window wouldn't have). One successful probe disarms it for the run.
+    probe_window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", "300"))
     wall_budget_s = float(os.environ.get("BENCH_WALL_BUDGET_S", "7200"))
     # Below this remaining-time floor a measurement attempt cannot plausibly
     # finish (engine init alone is ~30 s + compile ~60 s + measure ~90 s,
@@ -742,15 +913,23 @@ def main() -> None:
         print(f"# {msg}", file=sys.stderr)
 
     attempt = 0
+    backend_ever_seen = False
     while attempt < attempts:
         # Probe cycle: spin on cheap probes while the backend is dead —
         # never launch a child that will burn an attempt timeout learning
         # what a probe learns in seconds.
         while probe_on:
-            ok, diag = _probe_backend(min(probe_timeout_s, max(
-                remaining() - min_attempt_s, 10.0)))
+            window_left = (probe_window_s
+                           - (time.monotonic() - _STATE["t0"]))
+            cap = max(remaining() - min_attempt_s, 10.0)
+            if not backend_ever_seen:
+                # Keep the fast-fail honest: a single probe must not hang
+                # past the window it is supposed to bound.
+                cap = min(cap, max(window_left, 10.0))
+            ok, diag = _probe_backend(min(probe_timeout_s, cap))
             note(diag)
             if ok:
+                backend_ever_seen = True
                 break
             if remaining() < min_attempt_s + probe_backoff_s:
                 _emit_final({
@@ -759,6 +938,24 @@ def main() -> None:
                     "error": ("backend never came up within wall budget "
                               f"({wall_budget_s:.0f}s); probes: "
                               + " | ".join(_STATE["log"][-6:]))[:800],
+                    **_last_known_good(),
+                })
+                sys.exit(1)
+            if (not backend_ever_seen
+                    and time.monotonic() - _STATE["t0"] >= probe_window_s):
+                # FIRST probe window expired with zero signs of life: the
+                # tunnel is dead-on-arrival. Partial JSON now beats a full
+                # wall budget of probes saying the same thing — and the
+                # last_known_good pointer tells the reader what the engine
+                # measured when the backend last existed.
+                _emit_final({
+                    "metric": "p50_latency_ms", "value": None, "unit": "ms",
+                    "vs_baseline": None, "partial": True,
+                    "error": ("backend dead on arrival: no probe succeeded "
+                              f"within BENCH_PROBE_WINDOW_S="
+                              f"{probe_window_s:.0f}s; probes: "
+                              + " | ".join(_STATE["log"][-6:]))[:800],
+                    **_last_known_good(),
                 })
                 sys.exit(1)
             time.sleep(probe_backoff_s)
@@ -808,6 +1005,7 @@ def main() -> None:
         "vs_baseline": None,
         "error": (f"no measurement within budget; log: "
                   + " | ".join(_STATE["log"][-6:]))[:800],
+        **_last_known_good(),
     })
     sys.exit(1)
 

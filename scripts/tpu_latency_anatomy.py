@@ -1,25 +1,19 @@
-"""Anatomy of per-execute latency on the current backend → JSON artifact.
+"""Standalone entrypoint for the latency-anatomy probes → JSON artifact.
 
-Round-5 hardware showed every serving dispatch costs ~72-78 ms whether the
-chunk is 1 row or 32 (BENCH_r05.json: p50 72.0 ms, 10-row batches at 13.9
-dispatches/s, 32-row at 12.7), while a trivial jitted op completes in
-~0.03 ms. This probe separates the candidate costs so the number can be
-attributed instead of guessed at:
+The probes themselves live in ``bench._anatomy_probes`` — the bench runs
+them as a bounded post-headline stage on every round, so ``BENCH_*.json``
+artifacts carry ``manyarg_exec_ms`` / ``roundtrip_ms`` (and
+``bigarg_exec_ms`` off-TINY) next to the p50 they explain. This script
+remains for ad-hoc runs against a backend WITHOUT paying a full bench
+(e.g. sanity-probing a fresh tunnel), and additionally reports
+``tiny_exec_ms`` (the dispatch floor, which the bench times inside its
+own measurement as ``dispatch_floor_ms``).
 
-  tiny_exec_ms        one-input jitted op, resident arg (the floor)
-  roundtrip_ms        device_put + host fetch of 4 bytes, fresh data each
-                      rep (defeats host-copy caching) — the true RTT
-  manyarg_exec_ms     trivial jitted fn over 192 small resident arrays —
-                      per-ARGUMENT marshalling cost (a serving forward
-                      passes the whole param tree every call)
-  bigarg_exec_ms      trivial jitted fn over 4 x 128 MB resident arrays —
-                      per-BYTE cost for resident args (should be ~free:
-                      buffers live on device; only handles cross the wire)
-
-If manyarg_exec dominates, the serving fix is fewer/larger param leaves
-(or embedding params as compiled constants); if roundtrip dominates, the
-latency is the tunnel's and vanishes on locally-attached TPU; if neither,
-the forward's 72 ms is genuine device time and worth a profiler trace.
+Interpretation guide (also in ``_anatomy_probes``'s docstring): manyarg
+dominating → per-argument marshalling, fix is fewer/larger execute args
+(the engine's O(1)-leaf rows path); roundtrip dominating → tunnel RTT,
+vanishes on locally-attached TPU; neither → the latency is genuine device
+time, take a profiler trace.
 
 Usage: python scripts/tpu_latency_anatomy.py [--out FILE.json] [--reps 20]
 """
@@ -29,21 +23,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import statistics
 import sys
-import time
 
-# Runnable from anywhere: sys.path[0] is scripts/, the package lives one up.
+# Runnable from anywhere: sys.path[0] is scripts/, bench.py lives one up.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def _median_ms(fn, reps: int) -> float:
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        fn()
-        ts.append((time.perf_counter() - t0) * 1e3)
-    return round(statistics.median(ts), 3)
 
 
 def main(argv=None) -> int:
@@ -52,49 +35,16 @@ def main(argv=None) -> int:
     p.add_argument("--reps", type=int, default=20)
     args = p.parse_args(argv)
 
+    from bench import _anatomy_probes
+
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     dev = jax.devices()[0]
     report = {"metric": "latency_anatomy", "unit": "ms",
               "device_kind": dev.device_kind, "backend": dev.platform,
               "reps": args.reps}
-
-    # 1. Floor: one resident arg, trivial compute.
-    tiny = jax.jit(lambda x: x + 1.0)
-    x = jax.device_put(jnp.zeros((8, 128), jnp.float32))
-    jax.block_until_ready(tiny(x))
-    report["tiny_exec_ms"] = _median_ms(
-        lambda: jax.block_until_ready(tiny(x)), args.reps)
-
-    # 2. True round trip: fresh host data up, scalar back, per rep. A float()
-    # on a fresh device array cannot be served from any host-side cache.
-    def rt(i=[0]):
-        i[0] += 1
-        y = jax.device_put(np.array([i[0]], np.float32))
-        assert float(y[0]) == i[0]
-    rt()
-    report["roundtrip_ms"] = _median_ms(rt, args.reps)
-
-    # 3. Arg-count cost: a serving forward ships the ~190-leaf param tree
-    # as execute arguments every call. Same leaf count, trivial bytes and
-    # compute, isolates the per-argument marshalling term.
-    leaves = [jax.device_put(jnp.full((16,), float(i), jnp.float32))
-              for i in range(192)]
-    manyarg = jax.jit(lambda *ls: ls[0][0] + ls[-1][0])
-    jax.block_until_ready(manyarg(*leaves))
-    report["manyarg_exec_ms"] = _median_ms(
-        lambda: jax.block_until_ready(manyarg(*leaves)), args.reps)
-
-    # 4. Arg-bytes cost: few args, serving-scale bytes (4 x 128 MB ≈ the
-    # f32 param tree). Resident buffers should make this ~free.
-    big = [jax.device_put(jnp.zeros((32, 1024, 1024), jnp.float32))
-           for _ in range(4)]
-    bigarg = jax.jit(lambda a, b, c, d: a[0, 0, 0] + d[0, 0, 0])
-    jax.block_until_ready(bigarg(*big))
-    report["bigarg_exec_ms"] = _median_ms(
-        lambda: jax.block_until_ready(bigarg(*big)), args.reps)
+    report.update(_anatomy_probes(reps=args.reps, include_bigarg=True,
+                                  include_tiny=True))
 
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)

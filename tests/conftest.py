@@ -60,6 +60,7 @@ SLOW_TESTS = {
     "test_mesh_sharded_run_many_matches_single_device",
     "test_mesh_sharded_engine_matches_single_device",
     "test_transfer_dtype_follows_compute_dtype",
+    "test_bf16_param_storage_decode_parity",
     "test_device_input_cache_lru_eviction",
     "test_warmup_falls_back_to_xla_when_kernel_rejected",
     "test_input_cache_stats_counts",
@@ -76,6 +77,7 @@ SLOW_TESTS = {
     # bench machinery that spawns subprocess children / XLA cost analyses
     "test_probe_skipped_in_tiny_mode",
     "test_dead_backend_probes_then_structured_failure",
+    "test_dead_on_arrival_window_fast_fails_with_pointer",
     "test_flops_estimate_vs_xla_cost_analysis",
 }
 

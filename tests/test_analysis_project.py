@@ -396,6 +396,127 @@ def test_vmt112_clean_for_allowed_direction():
     assert not {r for r, _ in hits} & {"VMT112"}
 
 
+# ---------------------------------------------------------------- VMT113
+def test_vmt113_direct_transfer_in_hot_loop():
+    """device_put inside a loop in an engine serving entry fires."""
+    fs = findings({
+        "pkg/engine/runtime.py": """
+        import jax
+
+        class Engine:
+            def run_many(self, reqs):
+                out = []
+                for r in reqs:
+                    out.append(jax.device_put(r))
+                return out
+        """,
+    })
+    hits = [f for f in fs if f.rule == "VMT113"]
+    assert len(hits) == 1
+    assert "jax.device_put" in hits[0].message
+    assert "run_many" in hits[0].message
+
+
+def test_vmt113_transfer_through_project_call_chain():
+    """A loop calling a helper that transitively device_gets fires, with a
+    witness chain naming the concrete transfer — across modules."""
+    fs = findings({
+        "pkg/engine/runtime.py": """
+        from pkg.engine.fetch import pull
+
+        class Engine:
+            def run(self, reqs):
+                out = []
+                while reqs:
+                    out.append(pull(reqs.pop()))
+                return out
+        """,
+        "pkg/engine/fetch.py": """
+        import jax
+
+        def pull(x):
+            return jax.device_get(x)
+        """,
+    })
+    hits = [f for f in fs if f.rule == "VMT113"]
+    assert len(hits) == 1
+    assert "pkg.engine.fetch:pull" in hits[0].message
+    assert "jax.device_get" in hits[0].message
+
+
+def test_vmt113_quiet_outside_hot_path_and_outside_loops():
+    """Same transfer shapes stay silent when not in an engine entry's loop:
+    a non-engine module, a hot function without a loop, and a comprehension
+    (the repo's one-fused-transfer idiom) all pass."""
+    fs = findings({
+        # Not an engine module: name pattern doesn't match.
+        "pkg/train/loop.py": """
+        import jax
+
+        def run_many(batches):
+            return [jax.device_put(b) for b in batches]
+        """,
+        "pkg/engine/runtime.py": """
+        import jax
+
+        class Engine:
+            def run(self, req):
+                # No loop: one fused transfer per forward is the design.
+                return jax.device_put(req)
+
+            def run_many(self, reqs):
+                # Comprehension, not a loop: builds ONE fused device_put.
+                packed = {k: v for k, v in reqs}
+                return jax.device_put(packed)
+        """,
+    })
+    assert not [f for f in fs if f.rule == "VMT113"]
+
+
+def test_vmt113_hot_reachability_crosses_helpers():
+    """The hot set is transitive: a helper called from run() that loops
+    over transfers fires even though the helper's name matches nothing."""
+    fs = findings({
+        "pkg/engine/runtime.py": """
+        import jax
+
+        def _upload_rows(rows):
+            out = []
+            for r in rows:
+                out.append(jax.device_put(r))
+            return out
+
+        def run(reqs):
+            return _upload_rows(reqs)
+        """,
+    })
+    hits = [f for f in fs if f.rule == "VMT113"]
+    assert len(hits) == 1
+    assert "_upload_rows" in hits[0].message or "run" in hits[0].message
+
+
+def test_vmt113_own_engine_loops_are_baselined_pipelining():
+    """The real engine's only VMT113 findings are run_many's deliberate
+    per-chunk pipelining (dispatch + drain), each carried by a justified
+    baseline entry — the rule must not regress into noise on the tree it
+    polices."""
+    import os
+
+    from vilbert_multitask_tpu.analysis import baseline as bl
+    from vilbert_multitask_tpu.analysis.core import analyze_file
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    fs = [f for f in analyze_file(
+        os.path.join(root, "vilbert_multitask_tpu/engine/runtime.py"),
+        root=root) if f.rule == "VMT113"]
+    assert fs, "run_many's pipelined dispatch/drain should be visible"
+    baseline = bl.load_baseline(os.path.join(root, "vmtlint_baseline.json"))
+    for f in fs:
+        assert f.fingerprint() in baseline, (
+            f"unbaselined engine hot-loop transfer: {f.path}:{f.line} "
+            f"{f.message}")
+
+
 # ------------------------------------------------------------------- CLI
 @pytest.fixture()
 def lint_repo(tmp_path, monkeypatch):

@@ -64,6 +64,37 @@ def test_dead_backend_probes_then_structured_failure():
     assert elapsed < 120, elapsed
 
 
+def test_dead_on_arrival_window_fast_fails_with_pointer():
+    """A generous wall budget must NOT buy a wall budget of probes: if no
+    probe has EVER succeeded by BENCH_PROBE_WINDOW_S, bench emits partial
+    JSON pointing at the newest committed artifact and exits — minutes
+    after a dead-on-arrival tunnel, not hours (the round-5 builder spent
+    1798 s learning what its first 5 minutes already knew)."""
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, BENCH],
+        env=_outage_env(BENCH_WALL_BUDGET_S="600", BENCH_MIN_ATTEMPT_S="10",
+                        BENCH_PROBE_WINDOW_S="15"),
+        capture_output=True, text=True, timeout=300,
+    )
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 1, r.stderr[-2000:]
+    line = next(ln for ln in r.stdout.splitlines()
+                if ln.startswith('{"metric"'))
+    payload = json.loads(line)
+    assert payload["value"] is None
+    assert payload["partial"] is True
+    assert "backend dead on arrival" in payload["error"]
+    assert "BENCH_PROBE_WINDOW_S=15" in payload["error"]
+    # The failure points its reader at the last real measurement, so a dead
+    # tunnel can never read as "the engine got slow".
+    assert payload["last_known_good"].startswith("BENCH_")
+    assert isinstance(payload["last_known_good_p50_ms"], (int, float))
+    # Window + a couple of probe cycles of slack — nowhere near the budget.
+    assert elapsed < 120, elapsed
+    assert "bench attempt" not in r.stderr
+
+
 def test_sigterm_during_outage_emits_partial_json():
     """``timeout``'s SIGTERM mid-run still leaves structured stdout."""
     proc = subprocess.Popen(

@@ -296,8 +296,8 @@ def test_partition_rules_shard_big_matmuls(tiny_config):
 
 
 def test_device_input_cache_hit_and_parity(engine):
-    """cache_keys pins the region tensors on device after the first run; a
-    repeat request reuses the SAME placed buffers (no re-upload) and decodes
+    """cache_keys pins the region row in a slab slot after the first run; a
+    repeat request resolves to the SAME slot (no re-upload) and decodes
     identically to an uncached run."""
     regions = make_regions(1, feat_dim=engine.cfg.model.v_feature_size, seed=3)
     cached = engine.prepare(1, "what is on the table", regions,
@@ -306,12 +306,12 @@ def test_device_input_cache_hit_and_parity(engine):
     assert cached.cache_keys == ["imgA"] and plain.cache_keys is None
 
     _, r1 = engine.run(cached)
-    placed_first = engine._row_tensors(cached, 0)
-    assert engine._row_tensors(cached, 0) is placed_first  # LRU hit, same dict
-    import jax
-
-    assert all(isinstance(v, jax.Array) for v in placed_first.values())
+    slot = engine._input_cache["imgA"]
+    assert slot != 0  # slot 0 is the permanent pad row, never a cache entry
+    hits_before = engine.input_cache_stats["hits"]
     _, r2 = engine.run(cached)
+    assert engine._input_cache["imgA"] == slot  # LRU hit, same slab slot
+    assert engine.input_cache_stats["hits"] > hits_before
     _, r_plain = engine.run(plain)
     a1 = [a["confidence"] for a in r1.answers]
     assert a1 == [a["confidence"] for a in r2.answers]
@@ -359,8 +359,9 @@ def test_run_many_uses_device_cache_and_matches_solo(engine):
 
 
 def test_retrieval_pads_with_shared_device_row(engine):
-    """Bucket padding reuses ONE device-resident pad row (no per-request
-    pad upload), and padded results still match unpadded ones."""
+    """Bucket padding resolves to slab slot 0 — the permanent device-resident
+    pad row (no per-request pad upload, ever) — and padded requests still
+    decode all real rows."""
     import jax
 
     feat_dim = engine.cfg.model.v_feature_size
@@ -368,12 +369,76 @@ def test_retrieval_pads_with_shared_device_row(engine):
     req = engine.prepare(7, "a dog on a beach", regions,
                          cache_keys=["p0", "p1", "p2"])
     assert req.bucket == 4 and req.n_images == 3
-    feat_rows, spat_rows, mask_rows = engine._image_rows(req)
-    pad = engine._pad_row()
-    assert feat_rows[3] is pad["features"]  # the shared device row, not host
-    assert isinstance(pad["features"], jax.Array)
+    slab, slots = engine._pack_rows(engine._request_rows(req), req.bucket)
+    assert slots.shape == (4,) and slots[3] == 0  # pad row = slab slot 0
+    assert all(s != 0 for s in slots[:3])  # real rows never alias the pad
+    assert all(isinstance(v, jax.Array) for v in slab.values())
+    # Slot 0 carries the canonical pad content: zero features, mask[0]=1.
+    assert float(jax.device_get(slab["features"])[0].sum()) == 0.0
+    assert int(jax.device_get(slab["image_mask"])[0][0]) == 1
     _, res = engine.run(req)
     assert len(res.ranking) == 3
+
+
+def test_rows_dispatch_leaf_count_is_constant(engine, monkeypatch):
+    """O(1)-leaf regression: the rows program's per-dispatch argument tree
+    (slab + pack) must have the SAME leaf count at bucket 1 and bucket 4 —
+    3 slab tensors + 5 pack tensors, never 3×bucket image leaves. A leaf
+    count that scales with bucket size is the round-5 per-dispatch
+    marshalling cost (bench.py ``manyarg_exec_ms``) creeping back in."""
+    import jax
+
+    counts = {}
+    real = engine._call_forward
+
+    def spy(bucket, collect_attention, *args, **kw):
+        counts[bucket] = len(jax.tree_util.tree_leaves(args))
+        return real(bucket, collect_attention, *args, **kw)
+
+    monkeypatch.setattr(engine, "_call_forward", spy)
+    feat_dim = engine.cfg.model.v_feature_size
+    engine.run(engine.prepare(1, "what is this",
+                              make_regions(1, feat_dim=feat_dim, seed=21)))
+    engine.run(engine.prepare(7, "a dog on a beach",
+                              make_regions(3, feat_dim=feat_dim, seed=22)))
+    assert counts[1] == counts[4] == 8, counts
+
+
+def test_bf16_param_storage_decode_parity(tiny_config):
+    """EngineConfig.param_dtype="bfloat16" halves served-weight HBM; decodes
+    must stay within bf16 rounding of the f32 engine for EVERY decode
+    family's head — the parity gate on the serving storage mode."""
+    import jax
+    import jax.numpy as jnp
+
+    eng32 = InferenceEngine(FrameworkConfig(
+        model=tiny_config, engine=_cpu_engine_cfg(max_regions=11)), seed=0)
+    host = jax.device_get(eng32.params)  # f32 masters, checkpoint-shaped
+    engbf = InferenceEngine(FrameworkConfig(
+        model=tiny_config,
+        engine=dataclasses.replace(_cpu_engine_cfg(max_regions=11),
+                                   param_dtype="bfloat16"),
+    ), params=host)
+    for leaf in jax.tree_util.tree_leaves(engbf.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16, leaf.dtype
+
+    feat_dim = tiny_config.v_feature_size
+    for task_id, spec in sorted(TASK_REGISTRY.items()):
+        regions = make_regions(spec.min_images, feat_dim=feat_dim,
+                               seed=40 + task_id)
+        question = spec.placeholder or "what is in the picture"
+        out32, res32 = eng32.run(eng32.prepare(task_id, question, regions))
+        outbf, resbf = engbf.run(engbf.prepare(task_id, question, regions))
+        head32 = np.asarray(
+            jax.device_get(getattr(out32, spec.head)), np.float32)
+        headbf = np.asarray(
+            jax.device_get(getattr(outbf, spec.head)), np.float32)
+        np.testing.assert_allclose(
+            headbf, head32, rtol=0.1, atol=0.05,
+            err_msg=f"task {task_id} ({spec.name}) head {spec.head}")
+        assert resbf.task_id == res32.task_id == task_id
+        assert type(resbf) is type(res32)
 
 
 def test_transfer_dtype_follows_compute_dtype(tiny_config):

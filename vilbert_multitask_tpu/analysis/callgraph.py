@@ -12,7 +12,7 @@ an unknown object) makes no edge — the analyses built on top are
 deliberately under-approximate everywhere except thread-entry naming,
 which falls back to terminal-name matching (see ``_entry_candidates``).
 
-Three fixed points live here:
+Five fixed points live here:
 
 - ``traced``: functions reachable from any jit body inherit traced
   context (interprocedural VMT101/102/103), each with a witness chain;
@@ -22,7 +22,16 @@ Three fixed points live here:
 - ``thread_reachable``: functions reachable from thread entry points
   (``threading.Thread(target=...)``, executor ``submit``/``map``,
   ``BaseHTTPRequestHandler`` do_* verbs, ``threading.Thread`` run
-  overrides) — the evidence side of the VMT110 race detector.
+  overrides) — the evidence side of the VMT110 race detector;
+- ``hot_reachable``: functions reachable from the engine's serving
+  entry points (``run``/``run_many``/``predict``/``_dispatch*`` in
+  ``*.engine.*`` modules) — the "is this on the latency path" evidence
+  for VMT113;
+- ``transfers``: functions that perform a host<->device transfer —
+  ``jax.device_put``/``device_get``/``block_until_ready`` directly, or
+  any project callee that does, transitively — each with a witness
+  chain down to the concrete transfer call (the payload side of
+  VMT113).
 """
 
 from __future__ import annotations
@@ -58,6 +67,8 @@ class CallGraph:
         self.traced: Dict[str, str] = self._propagate_traced()
         self.donations: Dict[str, Set[int]] = self._propagate_donations()
         self.thread_reachable: Dict[str, str] = self._propagate_threads()
+        self.hot_reachable: Dict[str, str] = self._propagate_hot()
+        self.transfers: Dict[str, str] = self._propagate_transfers()
 
     # ------------------------------------------------------------ indexing
     def _index_module(self, mod) -> None:
@@ -339,6 +350,85 @@ class CallGraph:
                     reachable[target] = f"{reachable[qual]} -> `{qual}`"
                     frontier.append(target)
         return reachable
+
+    # ----------------------------------------------------- engine hot path
+    # Serving entry points: the methods callers hit per query. Matched by
+    # name inside engine modules (``pkg.engine`` or ``pkg.engine.*``) so a
+    # split of runtime.py doesn't silently drop the seed set.
+    _HOT_ENTRY_NAMES = {"run", "run_many", "predict"}
+
+    def _hot_entries(self) -> Iterator[Tuple[str, str]]:
+        for fn in self.functions.values():
+            mod_name = fn.module.name
+            if not (mod_name.endswith(".engine")
+                    or ".engine." in mod_name):
+                continue
+            leaf = fn.scope[-1]
+            if leaf in self._HOT_ENTRY_NAMES or leaf.startswith("_dispatch"):
+                yield fn.qualname, f"serving entry `{fn.qualname}`"
+
+    def _propagate_hot(self) -> Dict[str, str]:
+        """Fixed point: everything call-reachable from a serving entry is
+        on the latency hot path, with a witness chain back to the entry."""
+        reachable: Dict[str, str] = {}
+        frontier: List[str] = []
+        for qual, how in self._hot_entries():
+            if qual not in reachable:
+                reachable[qual] = how
+                frontier.append(qual)
+        while frontier:
+            qual = frontier.pop()
+            for target, _ in self.functions[qual].edges:
+                if target not in reachable:
+                    reachable[target] = f"{reachable[qual]} -> `{qual}`"
+                    frontier.append(target)
+        return reachable
+
+    def hot_in(self, mod) -> List[Tuple[FuncNode, str]]:
+        return sorted(
+            ((self.functions[q], w) for q, w in self.hot_reachable.items()
+             if self.functions[q].module is mod),
+            key=lambda fw: fw[0].qualname)
+
+    # ------------------------------------------------------------ transfers
+    _TRANSFER_CALLS = {"jax.device_put", "jax.device_get",
+                       "jax.block_until_ready"}
+
+    def _propagate_transfers(self) -> Dict[str, str]:
+        """Backward fixed point: a function performs a host<->device
+        transfer if its own body calls one of ``_TRANSFER_CALLS``, or it
+        calls (not merely references) a project function that does. The
+        witness chains caller-to-callee down to the concrete call."""
+        transfers: Dict[str, str] = {}
+        for fn in self.functions.values():
+            for node in self._own_nodes(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = fn.module.ctx.resolve(node.func)
+                if resolved in self._TRANSFER_CALLS:
+                    transfers[fn.qualname] = (
+                        f"calls `{resolved}` at line {node.lineno}")
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions.values():
+                if fn.qualname in transfers:
+                    continue
+                for target, is_call in fn.edges:
+                    if is_call and target in transfers:
+                        transfers[fn.qualname] = (
+                            f"via `{target}`: {transfers[target]}")
+                        changed = True
+                        break
+        return transfers
+
+    def own_call_nodes(self, fn: FuncNode) -> Iterator[ast.Call]:
+        """Call expressions belonging to ``fn``'s own body — nested
+        function/class scopes excluded (they are their own graph nodes)."""
+        for node in self._own_nodes(fn.node):
+            if isinstance(node, ast.Call):
+                yield node
 
     def class_thread_witness(self, mod, cls_node: ast.ClassDef
                              ) -> Optional[str]:

@@ -234,6 +234,22 @@ class ProjectGraph:
                     out[alias] = d
         return out
 
+    def hot_path_functions(self, ctx: ModuleContext):
+        """Functions in this module that are call-reachable from an engine
+        serving entry point (``run``/``run_many``/``predict``/
+        ``_dispatch*``), each with its witness chain — the VMT113 scope."""
+        mod = self.module(ctx)
+        if mod is None:
+            return []
+        return self.callgraph.hot_in(mod)
+
+    def transfer_witness(self, qualname: Optional[str]) -> Optional[str]:
+        """Witness chain if the named project function (transitively)
+        performs a host<->device transfer, else None."""
+        if qualname is None:
+            return None
+        return self.callgraph.transfers.get(qualname)
+
     def thread_witness(self, ctx: ModuleContext, cls_node: ast.ClassDef
                        ) -> Optional[str]:
         """If any function belonging to this class runs on a thread (is a

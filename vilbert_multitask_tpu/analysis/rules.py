@@ -951,10 +951,77 @@ class LayeringViolation(Rule):
                     f"must not depend on that one")
 
 
+# --------------------------------------------------------------------- 113
+_TRANSFER_EFFECTS = {
+    "jax.device_put": "uploads host bytes to the device",
+    "jax.device_get": "pulls device buffers back to the host",
+    "jax.block_until_ready": "stalls the host on device completion",
+}
+
+
+class PerRowTransferInLoop(Rule):
+    """Host<->device transfer inside a Python loop on the engine hot path.
+
+    The per-dispatch cost anatomy (bench ``roundtrip_ms``) showed each
+    host<->device round trip on a tunneled backend costs milliseconds; a
+    transfer issued once PER LOOP ITERATION in code reachable from the
+    serving entry points (``run``/``run_many``/``predict``) multiplies
+    that by the batch — the exact shape the O(1)-leaf row slab removed
+    from the rows path (one fused device_put per forward, index gathers
+    for cached rows). Flags both direct ``jax.device_put``/``device_get``/
+    ``block_until_ready`` calls and calls to project functions the call
+    graph proves perform one transitively, but only inside ``for``/
+    ``while`` bodies of hot-path functions (comprehensions are not loops
+    here: they are the repo's idiom for building ONE fused transfer).
+    Deliberate per-chunk transfers (run_many's pipelined dispatch/drain)
+    carry baseline justifications rather than suppressions — the finding
+    stays visible as the cost it is.
+    """
+
+    id = "VMT113"
+    name = "per-row-transfer-in-loop"
+    severity = "error"
+    description = ("host<->device transfer (direct or through a project "
+                   "call) inside a loop in a function reachable from the "
+                   "engine serving entry points")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        mod = ctx.project.module(ctx)
+        if mod is None:
+            return
+        cg = ctx.project.callgraph
+        for fn, hot in ctx.project.hot_path_functions(ctx):
+            for call in cg.own_call_nodes(fn):
+                if not ctx.in_loop(call):
+                    continue
+                resolved = ctx.resolve(call.func)
+                if resolved in _TRANSFER_EFFECTS:
+                    yield self.finding(
+                        ctx, call, f"`{resolved}` inside a loop on the "
+                        f"engine hot path ({hot}) "
+                        f"{_TRANSFER_EFFECTS[resolved]} once per iteration "
+                        f"— hoist it out, batch the rows into one fused "
+                        f"transfer, or keep the data device-resident")
+                    continue
+                target = cg.resolve_callable(mod, call.func, fn.scope,
+                                             fn.cls_scope)
+                witness = ctx.project.transfer_witness(target)
+                if witness:
+                    yield self.finding(
+                        ctx, call, f"`{target}` performs a host<->device "
+                        f"transfer ({witness}) and is called inside a loop "
+                        f"on the engine hot path ({hot}) — each iteration "
+                        f"pays a transfer round trip; batch the transfers "
+                        f"or justify the pipelining in the baseline")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
-         LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation]
+         LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
+         PerRowTransferInLoop]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

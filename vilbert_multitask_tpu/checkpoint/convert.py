@@ -247,10 +247,13 @@ def to_torch_state_dict(params: Dict, cfg: ViLBertConfig) -> Dict[str, Arr]:
 
 
 def load_torch_checkpoint(path: str, cfg: ViLBertConfig, *,
-                          strict: bool = True) -> Dict:
+                          strict: bool = True, dtype=np.float32) -> Dict:
     """Read a ``pytorch_model_*.bin`` (torch pickle) and convert.
 
     CPU-mapped, mirroring the reference's load (worker.py:83,530-532).
+    ``dtype`` feeds :func:`convert_torch_state_dict`'s leaf cast — keep the
+    f32 default for conversion-to-master-checkpoint flows; a serving-only
+    conversion may pass the engine's param_dtype to skip the second cast.
     """
     import torch
 
@@ -260,4 +263,4 @@ def load_torch_checkpoint(path: str, cfg: ViLBertConfig, *,
     sd = {k.replace("module.", "", 1) if k.startswith("module.") else k:
           v.numpy() if hasattr(v, "numpy") else np.asarray(v)
           for k, v in raw.items()}
-    return convert_torch_state_dict(sd, cfg, strict=strict)
+    return convert_torch_state_dict(sd, cfg, strict=strict, dtype=dtype)

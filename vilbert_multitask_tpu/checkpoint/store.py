@@ -29,7 +29,8 @@ def save_params(path: str, params: Any, *, force: bool = False) -> None:
                    force=force)
 
 
-def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:
+def restore_params(path: str, *, mesh=None, like: Optional[Any] = None,
+                   dtype=None) -> Any:
     """Restore a param pytree onto the accelerator.
 
     With ``mesh``, leaves land already sharded per the partition rules (no
@@ -37,15 +38,22 @@ def restore_params(path: str, *, mesh=None, like: Optional[Any] = None) -> Any:
     default device — restores are always device-resident, matching the
     reference's load-once-to-accelerator contract (worker.py:530-536). A
     host copy is never the steady state.
+
+    ``dtype`` is the serving param-storage cast (EngineConfig.param_dtype,
+    e.g. ``"bfloat16"``): floating leaves cast HOST-side before the upload,
+    so a bf16 restore ships half the checkpoint bytes. Checkpoints on disk
+    stay f32 masters — training restores (:func:`restore_train_state`)
+    never take this path and never downcast.
     """
     import orbax.checkpoint as ocp
+
+    from vilbert_multitask_tpu.parallel import sharding as shd
 
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         params = ckptr.restore(path)
+    params = shd.cast_floating(params, dtype)
     if mesh is not None:
-        from vilbert_multitask_tpu.parallel import sharding as shd
-
         params = jax.device_put(params, shd.param_shardings(params, mesh))
     else:
         params = jax.device_put(params)

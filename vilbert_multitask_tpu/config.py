@@ -246,6 +246,12 @@ class EngineConfig:
     # (the round-3 behavior).
     throughput_buckets: Sequence[int] | None = (16, 32)
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
+    # Param STORAGE dtype for serving (init_params / checkpoint restore /
+    # mesh placement all cast to it). "bfloat16" halves every weight read —
+    # at serving batch sizes the forward is weight-read-bound (see
+    # engine/flops.py roofline), so this is the serving-latency knob — and
+    # halves the boot upload. Training is unaffected: the trainer owns its
+    # own f32 master tree, and checkpoints on disk stay f32.
     param_dtype: str = "float32"
     # Default ON (round 3): serving runs the flash co-attention kernel on
     # TPU; bench.py probe-compiles it and degrades to the XLA path if Mosaic

@@ -107,3 +107,76 @@ def peak_flops_for(device_kind: str) -> float | None:
         if key in dk:
             return peak
     return None
+
+
+# Peak HBM bandwidth per chip (bytes/s), same substring keying. Sources:
+# published TPU spec sheets.
+PEAK_HBM_BYTES_PER_S = (
+    ("v5 lite", 819e9),  # v5e
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v6 lite", 1640e9),  # Trillium
+    ("v6e", 1640e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+# Off-TPU (CPU smoke runs, unknown device kinds) the roofline is still
+# worth stating against a reference chip so TINY bench artifacts carry the
+# same fields as hardware ones — the reason string names the substitution.
+_REFERENCE_CHIP = ("v5e", 197e12, 819e9)
+
+
+def peak_hbm_bw_for(device_kind: str) -> float | None:
+    dk = device_kind.lower()
+    for key, bw in PEAK_HBM_BYTES_PER_S:
+        if key in dk:
+            return bw
+    return None
+
+
+def param_tree_bytes(params) -> int:
+    """Total bytes of a device param tree — the weight-read term of the
+    serving roofline (every forward reads every parameter once)."""
+    import jax
+
+    return int(sum(
+        leaf.size * jax.numpy.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(params)))
+
+
+def serving_roofline(mcfg: ViLBertConfig, ecfg: EngineConfig, batch: int,
+                     device_kind: str, param_bytes: int) -> dict:
+    """Roofline cap on serving MFU at ``batch`` rows: a forward must read
+    all ``param_bytes`` from HBM once (t_mem) and execute the analytic
+    FLOPs (t_compute); achievable_mfu = t_compute / max(t_compute, t_mem).
+
+    When that ratio is well below 1 the forward is weight-read-bound and
+    more MXU (or a measured MFU "gap") is not the story — fewer weight
+    bytes (``EngineConfig.param_dtype="bfloat16"``) or bigger batches are.
+    Returns ``{"achievable_mfu", "reason"}``; unknown device kinds compute
+    against the v5e reference so the fields are always present.
+    """
+    peak = peak_flops_for(device_kind)
+    bw = peak_hbm_bw_for(device_kind)
+    note = ""
+    if peak is None or bw is None:
+        ref, peak, bw = _REFERENCE_CHIP
+        note = (f" [no spec table entry for {device_kind!r}; "
+                f"roofline stated against {ref}]")
+    flops = serving_forward_flops(mcfg, ecfg, batch)
+    t_compute = flops / peak
+    t_mem = param_bytes / bw
+    mfu = t_compute / max(t_compute, t_mem)
+    if t_mem > t_compute:
+        reason = (
+            f"weight-read-bound at batch {batch}: {param_bytes / 1e6:.0f} MB "
+            f"params / {bw / 1e9:.0f} GB/s = {t_mem * 1e3:.2f} ms HBM vs "
+            f"{t_compute * 1e3:.2f} ms compute — MFU caps at {mfu:.3f}")
+    else:
+        reason = (
+            f"compute-bound at batch {batch}: {t_compute * 1e3:.2f} ms "
+            f"compute vs {t_mem * 1e3:.2f} ms weight reads — MFU can "
+            f"approach 1.0")
+    return {"achievable_mfu": round(mfu, 4), "reason": reason + note}

@@ -13,16 +13,22 @@ model:
 - **repeat-batching stays**: NLVR2 pairs and retrieval candidates score in a
   single forward with the question replicated per image row, mirroring
   worker.py:266-284;
-- **bf16 compute / f32 params** on the MXU; softmaxes run in f32;
+- **bf16 compute** on the MXU; softmaxes run in f32. Params are stored in
+  ``EngineConfig.param_dtype`` (f32 default; ``"bfloat16"`` is the serving
+  mode that halves every weight read and the boot upload — training keeps
+  f32 master copies, the cast happens at init/restore time only);
 - **mesh-ready**: pass a ``Mesh`` and params are placed via the partition
   rules in :mod:`..parallel.sharding`; without one, single-device jit;
 - **host↔device bytes are the latency** on a tunneled/network-attached
-  chip, so the single-device program takes each image row as its own jit
-  argument (stacked to the batch INSIDE the compiled program): rows for
-  content-stable store images pin in HBM after first use (LRU input cache),
-  bucket padding reuses one shared device pad row, and features ship in
-  bf16 when the engine computes in bf16 — repeat queries upload ~KB of
-  text instead of ~MB of features;
+  chip, so the single-device program reads image rows out of a
+  device-resident **row slab** (one (S, Nv, ...) tensor per input kind)
+  via a per-call index vector: rows for content-stable store images pin
+  in their slab slot after first use (LRU input cache), bucket padding
+  reuses the permanent pad slot 0, and features ship in bf16 when the
+  engine computes in bf16 — repeat queries upload ~KB of text instead of
+  ~MB of features. The compiled forward signature is O(1) in bucket rows
+  (params + 3 slab leaves + one packed text/index tree), so per-dispatch
+  argument marshalling no longer scales with batch size;
 - label maps load once at boot (fixes the per-request pickle reload,
   SURVEY.md §2.4).
 """
@@ -130,6 +136,16 @@ class InferenceEngine:
         self.cfg = cfg or FrameworkConfig()
         ecfg = self.cfg.engine
         self.compute_dtype = jnp.dtype(ecfg.compute_dtype)
+        # Storage dtype of the served param tree (EngineConfig.param_dtype).
+        # bf16 halves every weight read at serving shapes — where the MXU is
+        # weight-read-bound, that is the roofline (see engine/flops.py) —
+        # and halves the one-time boot upload. Training never sees this:
+        # the trainer builds/restores its own f32 master tree.
+        self.param_dtype = jnp.dtype(ecfg.param_dtype)
+        if not jnp.issubdtype(self.param_dtype, jnp.floating):
+            raise ValueError(
+                f"engine.param_dtype must be a floating dtype, got "
+                f"{ecfg.param_dtype!r}")
         # Engine kernel knobs win over the model config, unconditionally —
         # kernel selection must not depend on which config carried a flag.
         model_cfg = dataclasses.replace(
@@ -172,7 +188,7 @@ class InferenceEngine:
                 boot_key = jax.random.PRNGKey(seed)
             params = self.init_params(boot_key)
         if mesh is not None:
-            params = shd.shard_params(params, mesh)
+            params = shd.shard_params(params, mesh, dtype=self.param_dtype)
         else:
             # Device-pin the tree ONCE, mirroring the reference's one-time
             # ``model.cuda(0)`` (worker.py:534-536). Without this, every
@@ -180,8 +196,10 @@ class InferenceEngine:
             # measured at 23.7 s/query over the remote-TPU link in round 2.
             # Already-committed device arrays (the init_params path) pass
             # through for free; host trees (checkpoint restores, test
-            # fixtures) upload exactly once here.
-            params = jax.device_put(params)
+            # fixtures) cast to param_dtype host-side (halving the bf16
+            # upload) and move exactly once here.
+            params = jax.device_put(
+                shd.cast_floating(params, self.param_dtype))
         jax.block_until_ready(params)
         self.params = params
         # keyed ('batched'|'rows', bucket, collect_attention, model_gen) —
@@ -203,12 +221,22 @@ class InferenceEngine:
         self._compile_lock = threading.Lock()
         # Device input cache: encoded region tensors for content-stable
         # (store-backed) images, pinned in HBM after first use — the input
-        # analogue of the one-time param device_put above. LRU over
-        # EngineConfig.device_input_cache_entries.
-        self._input_cache: "OrderedDict[str, dict]" = OrderedDict()
+        # analogue of the one-time param device_put above. Rows live in the
+        # row slab (see _row_slab); the cache maps key → slab slot, LRU
+        # over EngineConfig.device_input_cache_entries.
+        self._input_cache: "OrderedDict[str, int]" = OrderedDict()
         self._input_cache_lock = threading.Lock()
         self._input_cache_hits = 0
         self._input_cache_misses = 0
+        # Row slab state (built lazily under _input_cache_lock): the slab
+        # tensors, the free cache-slot pool, the scratch rotor, and the
+        # jitted single-row insert program.
+        self._slab: Optional[dict] = None
+        self._slab_free: List[int] = []
+        self._slab_scratch0 = 0
+        self._slab_scratch_n = 0
+        self._scratch_next = 0
+        self._slab_insert_fn = None
 
     # ------------------------------------------------------------------ init
     def _check_vocab_coherence(self) -> None:
@@ -240,9 +268,10 @@ class InferenceEngine:
                 "bert-base-uncased vocab for score parity",
                 n_rows, n_vocab, 100 * (1 - n_vocab / n_rows))
 
-    def _dummy_batch(self, batch: int):
+    def _dummy_host(self, batch: int) -> dict:
+        """Host-side all-zeros batch in exactly the dtypes prepare() ships."""
         ecfg, mcfg = self.cfg.engine, self.cfg.model
-        host = dict(
+        return dict(
             input_ids=np.zeros((batch, ecfg.max_text_len), np.int32),
             # Same dtype prepare() ships (transfer_dtype): a different input
             # dtype is a different XLA program — warmup must compile the one
@@ -255,10 +284,12 @@ class InferenceEngine:
             image_mask=np.ones((batch, ecfg.max_regions), np.int32),
             task_ids=np.zeros((batch, 1), np.int32),
         )
+
+    def _dummy_batch(self, batch: int):
         # One explicit fused upload instead of seven implicit jnp.zeros
         # scalar-fill transfers — keeps warmup legal under
         # jax.transfer_guard("disallow") (the conftest sanitizer fixture).
-        return jax.device_put(host)
+        return jax.device_put(self._dummy_host(batch))
 
     def init_params(self, rng):
         """Random init, entirely on device (even batch so the paired NLVR2
@@ -266,8 +297,9 @@ class InferenceEngine:
 
         The whole init runs under one jit so the tree is born on the chip —
         no device→host→device round trip (round 2's 259 s engine boot was
-        exactly that round trip over the remote-TPU link). Params live in
-        f32; compute casts to bf16 inside the model.
+        exactly that round trip over the remote-TPU link). Params land in
+        ``EngineConfig.param_dtype`` (f32 default; bf16 serving mode);
+        compute casts to the compute dtype inside the model either way.
         """
         d = self._dummy_batch(2)
         # Init through an XLA-attention twin: the Pallas and XLA paths create
@@ -283,6 +315,8 @@ class InferenceEngine:
                 use_pallas_self_attention=False),
             dtype=self.compute_dtype)
 
+        pdt = self.param_dtype
+
         def _init(rng):
             variables = init_model.init(
                 rng, d["input_ids"], d["features"], d["spatials"],
@@ -290,7 +324,7 @@ class InferenceEngine:
                 d["task_ids"], deterministic=True,
             )
             return jax.tree_util.tree_map(
-                lambda x: x.astype(jnp.float32)
+                lambda x: x.astype(pdt)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 variables["params"],
             )
@@ -357,28 +391,38 @@ class InferenceEngine:
             return fwd
 
     def _forward_rows(self, bucket: int, collect_attention: bool):
-        """Per-row-input program (the single-device serving path): each
-        image row (features/spatials/mask) is its own jit argument, stacked
-        to the (bucket, ...) batch INSIDE the compiled program. Rows that
-        are already device-resident (the input cache, the shared pad row)
-        upload nothing; host rows upload individually — same program either
-        way, no extra dispatch for the stack."""
+        """Row-slab program (the single-device serving path): image rows
+        live in the device-resident slab (:meth:`_row_slab`) and the
+        per-call ``pack`` carries the text tensors plus one (bucket,)
+        int32 slot-index vector; the (bucket, ...) batch is GATHERED from
+        the slab inside the compiled program. Rows that are already slab-
+        resident (the input cache, the permanent pad slot 0) upload
+        nothing. The flattened argument list is params + 3 slab leaves +
+        5 pack leaves — constant in bucket size, so per-dispatch argument
+        marshalling no longer scales with batch rows (the round-5
+        ``manyarg_exec_ms`` suspect). The pack is freshly uploaded every
+        call and never referenced again, so it is donated to XLA on
+        backends that implement input donation (the slab, persistent
+        cross-call state, must never be)."""
         key = ("rows", bucket, collect_attention, self._model_gen)
         with self._compile_lock:
             if key in self._compiled:
                 return self._compiled[key]
             _COMPILES.inc(program="rows")
             model = self.model
+            donate = (("pack",)
+                      if jax.default_backend() in ("tpu", "gpu") else ())
 
-            @partial(jax.jit, static_argnames=("attn",))
-            def fwd(params, text, feat_rows, spat_rows, mask_rows,
-                    attn=collect_attention):
+            @partial(jax.jit, static_argnames=("attn",),
+                     donate_argnames=donate)
+            def fwd(params, slab, pack, attn=collect_attention):
+                rows = pack["rows"]
                 out = model.apply(
                     {"params": params},
-                    text["input_ids"], jnp.stack(feat_rows),
-                    jnp.stack(spat_rows),
-                    text["segment_ids"], text["input_mask"],
-                    jnp.stack(mask_rows), None, text["task_ids"],
+                    pack["input_ids"], slab["features"][rows],
+                    slab["spatials"][rows],
+                    pack["segment_ids"], pack["input_mask"],
+                    slab["image_mask"][rows], None, pack["task_ids"],
                     deterministic=True, output_all_attention_masks=attn,
                     compute_pretraining_heads=False,
                 )
@@ -483,22 +527,23 @@ class InferenceEngine:
             parallel = self.cfg.engine.parallel_warmup
 
         def _warm_one(b: int) -> None:
-            batch = self._dummy_batch(b)
             if self.mesh is not None:
                 # Match run()'s input shardings exactly — a different input
                 # sharding is a different XLA program (fresh compile).
-                batch = shd.place_batch(batch, self.mesh)
+                batch = shd.place_batch(self._dummy_batch(b), self.mesh)
                 _, bundle = self._call_forward(b, False, batch)
             else:
-                # Warm the per-row program run()/run_many() actually use.
-                text = {k: batch[k] for k in
+                # Warm the slab program run()/run_many() actually use —
+                # dummy rows route through the scratch slots, which also
+                # warms the slab insert program.
+                host = self._dummy_host(b)
+                text = {k: host[k] for k in
                         ("input_ids", "segment_ids", "input_mask", "task_ids")}
-                _, bundle = self._call_forward(
-                    b, False, text,
-                    tuple(batch["features"][i] for i in range(b)),
-                    tuple(batch["spatials"][i] for i in range(b)),
-                    tuple(batch["image_mask"][i] for i in range(b)),
-                    rows=True)
+                rows = [(dict(features=host["features"][i],
+                              spatials=host["spatials"][i],
+                              image_mask=host["image_mask"][i]), None)
+                        for i in range(b)]
+                _, bundle = self._run_rows(b, False, text, rows)
             jax.block_until_ready(bundle["vil_logit"])
 
         if parallel and len(buckets) > 1:
@@ -651,54 +696,100 @@ class InferenceEngine:
         raise ValueError(f"unknown decode family {spec.decode}")
 
     # ---------------------------------------------------------------- serve
-    def _pad_row(self) -> dict:
-        """The shared device-resident padding row: all requests pad their
-        bucket with IDENTICAL rows (zero features, global box, mask[0]=1 —
-        features/pipeline.py batch_images), so one row lives in HBM per
-        engine and bucket padding uploads nothing, ever."""
-        if getattr(self, "_pad_row_cached", None) is None:
-            ecfg, mcfg = self.cfg.engine, self.cfg.model
-            spat = np.zeros((ecfg.max_regions, 5), np.float32)
-            spat[0] = GLOBAL_BOX
-            mask = np.zeros((ecfg.max_regions,), np.int32)
-            mask[0] = 1
-            self._pad_row_cached = jax.device_put(dict(
-                features=np.zeros((ecfg.max_regions, mcfg.v_feature_size),
-                                  self.transfer_dtype),
-                spatials=spat, image_mask=mask))
-        return self._pad_row_cached
+    def _row_slab(self) -> dict:
+        """The device-resident row slab: one (S, Nv, ...) tensor per image
+        input kind, S = 1 pad slot + cache slots + scratch slots.
 
-    def _row_tensors(self, req: PreparedRequest, i: int) -> dict:
-        """One image row (features/spatials/image_mask), device-cached when
-        the request carries a stable identity for it (store-backed images).
+        - slot 0 is the permanent padding row (zero features, global box,
+          mask[0]=1 — features/pipeline.py batch_images): bucket padding
+          references it by index and uploads nothing, ever;
+        - slots 1..cache_entries hold content-stable store rows (LRU, keyed
+          by the cache_keys from prepare()) — the round-3 input cache,
+          relocated from loose per-row device dicts into slab slots so the
+          forward can GATHER them with one index vector instead of taking
+          3×bucket leaf arguments;
+        - the trailing max_batch_rows() scratch slots receive novel/keyless
+          uploads, rotor-allocated per pack.
 
-        The reference re-ships every request's tensors over PCIe where the
-        copy is effectively free (worker.py:452-455); over a tunneled or
-        network-attached TPU the upload IS the latency, so content-stable
-        rows get the same one-time device placement as the params.
+        Built lazily ON DEVICE (a jitted zeros/constant program — no
+        multi-MB boot upload). Updates are functional (``.at[slot].set``),
+        so a forward dispatched against an older slab value keeps reading
+        consistent rows while later packs insert — which is what makes
+        run_many's bounded pipelining and scratch-rotor reuse safe.
         """
-        host = dict(features=req.features[i], spatials=req.spatials[i],
-                    image_mask=req.image_mask[i])
-        if req.cache_keys is None or req.cache_keys[i] is None:
-            # No stable identity → uploaded per call, but EXPLICITLY: every
-            # host→device move on the serve path is a deliberate device_put
-            # (the transfer-guard fixture in tests/conftest.py enforces it).
-            return jax.device_put(host)
-        key = req.cache_keys[i]
-        with self._input_cache_lock:
-            hit = self._input_cache.get(key)
-            if hit is not None:
+        if self._slab is None:
+            with self._input_cache_lock:
+                if self._slab is None:
+                    ecfg, mcfg = self.cfg.engine, self.cfg.model
+                    cache_slots = ecfg.device_input_cache_entries
+                    scratch = ecfg.max_batch_rows()
+                    n_rows = 1 + cache_slots + scratch
+                    nv, dim = ecfg.max_regions, mcfg.v_feature_size
+                    tdt = self.transfer_dtype
+                    box = tuple(float(v) for v in GLOBAL_BOX)
+
+                    def _build():
+                        spat = jnp.zeros((n_rows, nv, 5), jnp.float32)
+                        spat = spat.at[0, 0].set(jnp.array(box, jnp.float32))
+                        mask = jnp.zeros((n_rows, nv), jnp.int32)
+                        mask = mask.at[0, 0].set(1)
+                        return dict(
+                            features=jnp.zeros((n_rows, nv, dim), tdt),
+                            spatials=spat, image_mask=mask)
+
+                    self._slab_scratch0 = 1 + cache_slots
+                    self._slab_scratch_n = scratch
+                    self._slab_free = list(range(1, 1 + cache_slots))
+                    self._slab = jax.jit(_build)()
+        return self._slab
+
+    def _slab_insert(self, slot: int, host_row: dict) -> None:
+        """Upload one image row and write it into slab ``slot`` (caller
+        holds _input_cache_lock). One fused explicit device_put per row —
+        the same per-miss upload cost as the pre-slab cache — then one
+        tiny constant-leaf jitted update dispatch."""
+        if self._slab_insert_fn is None:
+            def _ins(slab, row):
+                i = row["slot"]
+                return {k: slab[k].at[i].set(row[k].astype(slab[k].dtype))
+                        for k in slab}
+
+            self._slab_insert_fn = jax.jit(_ins)
+        placed = jax.device_put(dict(
+            features=host_row["features"], spatials=host_row["spatials"],
+            image_mask=host_row["image_mask"],
+            slot=np.asarray(slot, np.int32)))
+        self._slab = self._slab_insert_fn(self._slab, placed)
+
+    def _row_slot_locked(self, host_row: dict, key: Optional[str]) -> int:
+        """Slab slot for one image row (caller holds _input_cache_lock):
+        cache hit → existing slot; keyed miss → LRU cache slot + insert;
+        keyless → next scratch slot + insert."""
+        if key is not None:
+            slot = self._input_cache.get(key)
+            if slot is not None:
                 self._input_cache.move_to_end(key)
                 self._input_cache_hits += 1
-                return hit
-        placed = jax.device_put(host)
-        with self._input_cache_lock:
+                return slot
             self._input_cache_misses += 1
-            self._input_cache[key] = placed
-            while (len(self._input_cache)
-                   > self.cfg.engine.device_input_cache_entries):
-                self._input_cache.popitem(last=False)
-        return placed
+            if self._slab_free:
+                slot = self._slab_free.pop()
+            else:
+                # Cache full: reuse the LRU entry's slot. In-flight
+                # forwards captured the pre-insert slab value, so the
+                # overwrite cannot corrupt a dispatched batch.
+                _, slot = self._input_cache.popitem(last=False)
+            self._input_cache[key] = slot
+        else:
+            # No stable identity → scratch rotor. One pack needs at most
+            # max_batch_rows slots (= the scratch region size), and the
+            # pack captures its slab value before releasing the lock, so
+            # rotor wrap-around by later packs is invisible to it.
+            slot = self._slab_scratch0 + (
+                self._scratch_next % self._slab_scratch_n)
+            self._scratch_next += 1
+        self._slab_insert(slot, host_row)
+        return slot
 
     @property
     def input_cache_stats(self) -> Dict[str, int]:
@@ -708,16 +799,37 @@ class InferenceEngine:
                     "hits": self._input_cache_hits,
                     "misses": self._input_cache_misses}
 
-    def _image_rows(self, req: PreparedRequest) -> Tuple[tuple, tuple, tuple]:
-        """Per-row image tensors for the rows program: real rows from the
-        cache (or host), pad rows from the shared device pad row."""
-        rows = [self._row_tensors(req, i) for i in range(req.n_images)]
-        if req.bucket > req.n_images:
-            pad = self._pad_row()
-            rows.extend([pad] * (req.bucket - req.n_images))
-        return (tuple(r["features"] for r in rows),
-                tuple(r["spatials"] for r in rows),
-                tuple(r["image_mask"] for r in rows))
+    def _pack_rows(self, rows: Sequence[Tuple[dict, Optional[str]]],
+                   bucket: int) -> Tuple[dict, np.ndarray]:
+        """Resolve each (host_row, cache_key) to a slab slot and return
+        (slab value, (bucket,) int32 slot vector); pad slots are 0. The
+        whole pack runs under one lock hold and captures the slab value
+        before releasing it, so concurrent packs can never recycle this
+        pack's scratch slots out from under its forward."""
+        self._row_slab()  # built outside the (non-reentrant) lock hold
+        with self._input_cache_lock:
+            slots = [self._row_slot_locked(row, key) for row, key in rows]
+            slab = self._slab
+        slots.extend([0] * (bucket - len(slots)))
+        return slab, np.asarray(slots, np.int32)
+
+    def _run_rows(self, bucket: int, collect_attention: bool,
+                  text_host: dict, rows: Sequence[Tuple[dict, Optional[str]]]):
+        """Dispatch the O(1)-leaf rows program: pack the image rows into
+        the slab, then ship text + slot indices as ONE fused explicit
+        device_put (the donated ``pack`` argument)."""
+        slab, slots = self._pack_rows(rows, bucket)
+        pack = jax.device_put({**text_host, "rows": slots})
+        return self._call_forward(bucket, collect_attention, slab, pack,
+                                  rows=True)
+
+    def _request_rows(self, req: PreparedRequest
+                      ) -> List[Tuple[dict, Optional[str]]]:
+        """A request's real image rows as (host_row, cache_key) pairs."""
+        return [(dict(features=req.features[i], spatials=req.spatials[i],
+                      image_mask=req.image_mask[i]),
+                 req.cache_keys[i] if req.cache_keys is not None else None)
+                for i in range(req.n_images)]
 
     def run(self, req: PreparedRequest, *, collect_attention: bool = False):
         """Device forward for a prepared request → (output, decoded result)."""
@@ -725,11 +837,6 @@ class InferenceEngine:
             input_ids=req.text.input_ids, segment_ids=req.text.segment_ids,
             input_mask=req.text.input_mask, task_ids=req.task_ids,
         )
-        if self.mesh is None:
-            # Explicit upload of the (KB-scale) text tensors — the jitted
-            # forward never receives host numpy implicitly (the mesh branch
-            # places them below via place_batch's sharded device_put).
-            text = jax.device_put(text)
         t0 = time.perf_counter()
         # The forward span closes only after the blocking device_get below —
         # jax dispatch is async, so fencing on the fetch is what makes the
@@ -747,10 +854,12 @@ class InferenceEngine:
                 out, bundle = self._call_forward(req.bucket,
                                                  collect_attention, batch)
             else:
-                feat_rows, spat_rows, mask_rows = self._image_rows(req)
-                out, bundle = self._call_forward(
+                # Slab path: cached rows resolve to slot indices (zero
+                # upload); text + the index vector ship as one explicit
+                # device_put inside _run_rows.
+                out, bundle = self._run_rows(
                     req.bucket, collect_attention, text,
-                    feat_rows, spat_rows, mask_rows, rows=True)
+                    self._request_rows(req))
             # One blocking fetch of the few-KB decode bundle — forward_s
             # includes the single device→host round trip; decode is then
             # pure host math.
@@ -934,21 +1043,18 @@ class InferenceEngine:
             batch = shd.place_batch(batch, self.mesh)
             _, bundle = self._call_forward(bucket, False, batch)
         else:
-            # Per-row image tensors: store-backed rows ride the device cache
-            # here too — under queue backlog (the batched path) repeat images
-            # cost no upload, same as solo serving. Pad slots use the shared
-            # device pad row (zero upload; discarded at decode).
-            rows = [self._row_tensors(r, i) for r, i in spans]
-            if pad:
-                rows.extend([self._pad_row()] * pad)
-            # Same explicit-upload contract as run(): packed text moves in
-            # one deliberate device_put, never as implicit numpy args.
-            text = jax.device_put(text)
-            _, bundle = self._call_forward(
-                bucket, False, text,
-                tuple(r["features"] for r in rows),
-                tuple(r["spatials"] for r in rows),
-                tuple(r["image_mask"] for r in rows), rows=True)
+            # Slab rows: store-backed rows ride the device cache here too —
+            # under queue backlog (the batched path) repeat images resolve
+            # to cached slab slots and cost no upload, same as solo
+            # serving. Pad slots reference the permanent pad slot 0
+            # (discarded at decode). Packed text + the slot-index vector
+            # move in one deliberate device_put inside _run_rows — the
+            # compiled signature stays O(1) in chunk rows.
+            rows = [(dict(features=r.features[i], spatials=r.spatials[i],
+                          image_mask=r.image_mask[i]),
+                     r.cache_keys[i] if r.cache_keys is not None else None)
+                    for r, i in spans]
+            _, bundle = self._run_rows(bucket, False, text, rows)
         return bundle
 
     def predict(

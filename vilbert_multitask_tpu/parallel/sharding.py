@@ -86,8 +86,36 @@ def param_shardings(params: Any, mesh: Mesh) -> Any:
     )
 
 
-def shard_params(params: Any, mesh: Mesh) -> Any:
-    """Place a host param tree onto the mesh per the rules (one-time at boot)."""
+def cast_floating(params: Any, dtype) -> Any:
+    """Cast the floating leaves of a param tree to ``dtype`` (ints/bools
+    pass through; leaves already in ``dtype`` are returned untouched).
+
+    The serving param-storage cast (EngineConfig.param_dtype): applied
+    host-side before the boot upload when possible — a bf16 serving tree
+    ships half the bytes of its f32 master — and shape-preserving, so
+    sharding rules and checkpoint trees are unaffected. ``dtype=None`` is
+    the identity (the training path: f32 masters are never cast here).
+    """
+    if dtype is None:
+        return params
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+
+    def one(x):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+            return x.astype(dt)
+        return x
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def shard_params(params: Any, mesh: Mesh, *, dtype=None) -> Any:
+    """Place a host param tree onto the mesh per the rules (one-time at
+    boot). ``dtype`` applies :func:`cast_floating` first — the serving
+    param-storage dtype rides the same placement call on the mesh path as
+    on the single-device path."""
+    params = cast_floating(params, dtype)
     return jax.device_put(params, param_shardings(params, mesh))
 
 

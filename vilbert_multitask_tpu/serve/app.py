@@ -70,7 +70,11 @@ class ServeApp:
             if checkpoint_path is not None:
                 from vilbert_multitask_tpu.checkpoint import restore_params
 
-                params = restore_params(checkpoint_path, mesh=mesh)
+                # Serving restore casts to the engine's param-storage dtype
+                # host-side (bf16 mode ships half the checkpoint bytes);
+                # the on-disk checkpoint stays the f32 master.
+                params = restore_params(checkpoint_path, mesh=mesh,
+                                        dtype=self.cfg.engine.param_dtype)
             store = FeatureStore(feature_root)
             if live_extract:
                 # Novel uploads with no precomputed .npy run through the

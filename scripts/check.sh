@@ -26,4 +26,10 @@ echo "== tier-1 tests (fast profile) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider || fail=1
 
+echo "== chaos smoke (seeded FaultPlan, no-lost-jobs invariant) =="
+# Short end-to-end soak under injected faults: every submitted job must
+# reach exactly one terminal state (result / dead-letter / deadline push).
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --chaos --jobs 15 \
+  --out /tmp/CHAOS_SOAK.json || fail=1
+
 exit "$fail"

@@ -476,6 +476,105 @@ def test_walltime_duration_suppressible():
     assert "VMT109" not in rules_hit(src)
 
 
+# ----------------------------------------------------------------- VMT114
+def test_naked_retry_loop_triggers():
+    # The exact shape serve/remote.py used to hand-roll: unbounded loop,
+    # catch, deterministic exponential sleep — lockstep retries forever.
+    src = """
+    import time
+
+    def fetch(url):
+        attempt = 0
+        while True:
+            try:
+                return get(url)
+            except ConnectionError:
+                time.sleep(0.5 * (2 ** attempt))
+                attempt += 1
+    """
+    assert "VMT114" in rules_hit(src)
+
+
+def test_naked_retry_loop_constant_sleep_triggers():
+    src = """
+    import time
+
+    def poll():
+        while 1:
+            try:
+                return read()
+            except OSError:
+                time.sleep(1.0)
+    """
+    assert "VMT114" in rules_hit(src)
+
+
+def test_bounded_retry_with_jitter_is_clean():
+    src = """
+    import random
+    import time
+
+    def fetch(url):
+        for attempt in range(5):
+            try:
+                return get(url)
+            except ConnectionError:
+                time.sleep(random.uniform(0, 0.5 * (2 ** attempt)))
+    """
+    assert "VMT114" not in rules_hit(src)
+
+
+def test_unbounded_loop_with_jittered_sleep_is_clean():
+    # Jitter alone desynchronizes the herd; the rule targets the compound
+    # hazard (the attempt bound is RetryPolicy's job to add).
+    src = """
+    import time
+
+    def watch(policy):
+        while True:
+            try:
+                return claim()
+            except ConnectionError:
+                time.sleep(policy.backoff_s(0))
+    """
+    assert "VMT114" not in rules_hit(src)
+
+
+def test_poll_loop_with_exit_condition_is_clean():
+    # run_forever's shape: a real exit condition makes it a poll loop,
+    # not a retry loop.
+    src = """
+    import time
+
+    def run_forever(stop):
+        while not stop.is_set():
+            try:
+                step()
+            except ValueError:
+                pass
+            time.sleep(0.05)
+    """
+    assert "VMT114" not in rules_hit(src)
+
+
+def test_sleep_in_nested_bounded_loop_is_clean():
+    # The sleep belongs to the bounded inner for-loop, not the outer
+    # while True service loop.
+    src = """
+    import time
+
+    def service():
+        while True:
+            try:
+                work()
+            except ValueError:
+                pass
+            for _ in range(3):
+                time.sleep(0.1)
+    """
+    assert "VMT114" not in rules_hit(src)
+
+
 # ----------------------------------------------- suppressions and baseline
 def test_inline_suppression_by_id_name_and_next_line():
     base = """

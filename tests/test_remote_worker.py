@@ -131,3 +131,50 @@ def test_build_remote_worker_reuses_engine(web_host, engine):
     w = build_remote_worker(url, engine=engine)
     assert w.engine is engine
     assert isinstance(w.queue, RemoteQueue)
+
+
+def test_remote_worker_survives_transport_flaps(web_host, engine):
+    """Injected transport faults (FaultInjected ⊂ ConnectionError) hit the
+    real retry path: the shared RetryPolicy jitters and retries, the job
+    still completes exactly once, and the breaker never trips (the flap
+    count stays under its threshold)."""
+    from vilbert_multitask_tpu.resilience import (
+        CircuitBreaker,
+        FaultPlan,
+        FaultRule,
+        RetryBudget,
+        RetryPolicy,
+        clear_plan,
+        install_plan,
+    )
+
+    s, hub, q, store, url = web_host
+    sub = hub.subscribe("sock-flap")
+    _submit(url, {"task_id": 1, "socket_id": "sock-flap",
+                  "question": "what is this", "image_list": ["img_a"]})
+    client = WorkerApiClient(
+        url,
+        retry=RetryPolicy(max_attempts=6, base_delay_s=0.001,
+                          max_delay_s=0.01,
+                          budget=RetryBudget(1e9, 1e9)),
+        breaker=CircuitBreaker(name="test.flap", failure_threshold=5,
+                               window_s=5.0, reset_timeout_s=0.05))
+    worker = ServeWorker(engine, RemoteQueue(client), RemoteStore(client),
+                         RemoteHub(client), s)
+    plan = install_plan(FaultPlan(3, [
+        FaultRule("remote.post", "error", rate=0.4, max_injections=4)]))
+    try:
+        done = 0
+        for _ in range(10):  # a flapped claim reads as "drained" → re-step
+            done += worker.step_batch()
+            if done:
+                break
+        assert done == 1
+        assert q.counts() == {}
+        assert plan.injections().get("remote.post", 0) > 0  # flaps happened
+    finally:
+        clear_plan()
+    frames = []
+    while not sub.empty():
+        frames.append(sub.get_nowait())
+    assert len([f for f in frames if "result" in f]) == 1  # exactly once

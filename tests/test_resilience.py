@@ -1,0 +1,428 @@
+"""resilience/: policy units (deadline, retry, breaker, admission), the
+fault-injection plane, and their serve-tier integration (expired-deadline
+terminal push, 429 shed, deadline on the wire, graceful drain)."""
+
+import dataclasses
+import http.client
+import json
+import queue as queue_mod
+import time
+
+import pytest
+
+from vilbert_multitask_tpu.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    FaultInjected,
+    FaultPlan,
+    FaultRule,
+    RetryBudget,
+    RetryPolicy,
+    clear_plan,
+    fault_point,
+    install_plan,
+)
+from vilbert_multitask_tpu.serve.http_api import ApiServer
+from vilbert_multitask_tpu.serve.queue import make_job_message
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    """No test may leak an installed FaultPlan into the rest of tier-1."""
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def _drain(sub) -> list:
+    frames = []
+    while True:
+        try:
+            frames.append(sub.get_nowait())
+        except queue_mod.Empty:
+            return frames
+
+
+# ------------------------------------------------------------- deadlines
+def test_deadline_monotonic_expiry():
+    d = Deadline(0.03)
+    assert not d.expired() and d.remaining_s() > 0
+    time.sleep(0.04)
+    assert d.expired() and d.remaining_s() < 0
+
+
+def test_deadline_wire_round_trip_preserves_budget():
+    d = Deadline(120.0)
+    wire = d.to_wire()
+    assert set(wire) == {"budget_s", "issued_unix"}
+    back = Deadline.from_wire(wire)
+    # Re-anchored in (this) process: nearly the full budget remains.
+    assert 119.0 < back.remaining_s() <= 120.0
+
+
+def test_deadline_expired_on_the_wire():
+    # Calendar math, not a duration: forging a wire stamp issued in the past.
+    wire = {"budget_s": 10.0,
+            "issued_unix": time.time() - 60.0}  # vmtlint: disable=VMT109
+    assert Deadline.from_wire(wire).expired()
+
+
+@pytest.mark.parametrize("garbage", [
+    None, "nope", 7, {}, {"budget_s": "x", "issued_unix": "y"},
+    {"budget_s": 5.0},
+])
+def test_deadline_from_wire_tolerates_garbage(garbage):
+    # Jobs published by pre-deadline clients must keep serving.
+    assert Deadline.from_wire(garbage) is None
+
+
+# --------------------------------------------------------------- retries
+def test_retry_backoff_is_full_jitter():
+    p = RetryPolicy(max_attempts=9, base_delay_s=0.5, max_delay_s=4.0)
+    import random
+
+    rng = random.Random(3)
+    for attempt, cap in [(0, 0.5), (1, 1.0), (2, 2.0), (3, 4.0), (6, 4.0)]:
+        draws = [p.backoff_s(attempt, rng=rng) for _ in range(50)]
+        assert all(0.0 <= d <= cap for d in draws)
+        assert len({round(d, 6) for d in draws}) > 10  # actually random
+
+
+def test_retry_call_retries_then_succeeds():
+    calls, sleeps = [], []
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.01,
+                    budget=RetryBudget(1e9, 1e9))
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("flap")
+        return "ok"
+
+    assert p.call(flaky, site="t.flaky", sleep=sleeps.append) == "ok"
+    assert len(calls) == 3 and len(sleeps) == 2
+
+
+def test_retry_call_exhausts_and_raises_last():
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                    budget=RetryBudget(1e9, 1e9))
+    with pytest.raises(ConnectionError, match="always"):
+        p.call(lambda: (_ for _ in ()).throw(ConnectionError("always")),
+               site="t.dead", sleep=lambda s: None)
+
+
+def test_retry_no_retry_propagates_immediately():
+    calls = []
+    p = RetryPolicy(max_attempts=5, budget=RetryBudget(1e9, 1e9))
+
+    class Fatal(ConnectionError):
+        """Deterministic subclass of the retryable class (HTTPError-style)."""
+
+    def fatal():
+        calls.append(1)
+        raise Fatal("401")
+
+    with pytest.raises(Fatal):
+        p.call(fatal, site="t.fatal", retry_on=(ConnectionError,),
+               no_retry=(Fatal,), sleep=lambda s: None)
+    assert len(calls) == 1  # never retried
+
+
+def test_retry_budget_stops_the_storm():
+    # Empty bucket, zero refill: each caller gets its first attempt and
+    # then fails fast instead of sleeping toward a dead dependency.
+    budget = RetryBudget(rate_per_s=0.0, capacity=1.0)
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.001, budget=budget)
+    sleeps = []
+
+    def dead():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        p.call(dead, site="t.budget", sleep=sleeps.append)  # spends the token
+    with pytest.raises(ConnectionError):
+        p.call(dead, site="t.budget", sleep=sleeps.append)  # budget empty
+    assert len(sleeps) == 1
+
+
+# -------------------------------------------------------------- breakers
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trips_after_threshold_in_window():
+    clk = FakeClock()
+    b = CircuitBreaker(name="t1", failure_threshold=3, window_s=10.0,
+                       reset_timeout_s=5.0, clock=clk)
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed"
+    b.preflight()  # still admits
+    b.record_failure()
+    assert b.state == "open"
+    with pytest.raises(CircuitOpenError):
+        b.preflight()
+
+
+def test_breaker_sliding_window_prunes_old_failures():
+    clk = FakeClock()
+    b = CircuitBreaker(name="t2", failure_threshold=3, window_s=10.0,
+                       clock=clk)
+    b.record_failure()
+    b.record_failure()
+    clk.t += 11.0  # both age out of the window
+    b.record_failure()
+    assert b.state == "closed"
+
+
+def test_breaker_half_open_probe_success_closes():
+    clk = FakeClock()
+    b = CircuitBreaker(name="t3", failure_threshold=1, window_s=10.0,
+                       reset_timeout_s=5.0, clock=clk)
+    b.record_failure()
+    assert b.state == "open"
+    clk.t += 5.0
+    assert b.state == "half_open"
+    b.preflight()  # the probe slot
+    with pytest.raises(CircuitOpenError):
+        b.preflight()  # only one probe admitted
+    b.record_success()
+    assert b.state == "closed"
+    b.preflight()  # closed again: calls flow
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clk = FakeClock()
+    b = CircuitBreaker(name="t4", failure_threshold=1, window_s=10.0,
+                       reset_timeout_s=5.0, clock=clk)
+    b.record_failure()
+    clk.t += 5.0
+    b.preflight()
+    b.record_failure()  # probe failed → re-open, timer restarts
+    assert b.state == "open"
+    clk.t += 4.9
+    assert b.state == "open"
+    clk.t += 0.2
+    assert b.state == "half_open"
+
+
+def test_retry_call_respects_breaker():
+    clk = FakeClock()
+    b = CircuitBreaker(name="t5", failure_threshold=2, window_s=60.0,
+                       reset_timeout_s=30.0, clock=clk)
+    p = RetryPolicy(max_attempts=10, base_delay_s=0.001,
+                    budget=RetryBudget(1e9, 1e9))
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    # Breaker opens after 2 failures; the loop then sheds WITHOUT calling.
+    with pytest.raises(CircuitOpenError):
+        p.call(dead, site="t.breaker", breaker=b, sleep=lambda s: None)
+    assert len(calls) == 2
+
+
+# -------------------------------------------------------------- admission
+def test_admission_sheds_on_depth_and_age():
+    a = AdmissionController(max_queue_depth=4, max_queue_age_s=30.0,
+                            retry_after_s=7.0)
+    assert a.admit(depth=3, oldest_age_s=1.0).admitted
+    d = a.admit(depth=4, oldest_age_s=1.0)
+    assert (d.admitted, d.reason, d.retry_after_s) == (False, "queue_depth", 7.0)
+    d = a.admit(depth=0, oldest_age_s=31.0)
+    assert (d.admitted, d.reason) == (False, "queue_age")
+    # Empty queue reports no age — admitted.
+    assert a.admit(depth=0, oldest_age_s=None).admitted
+
+
+def test_admission_zero_threshold_disables_signal():
+    a = AdmissionController(max_queue_depth=0, max_queue_age_s=0.0)
+    assert a.admit(depth=10_000, oldest_age_s=1e6).admitted
+
+
+# ------------------------------------------------------------ fault plane
+def test_fault_plan_same_seed_same_schedule():
+    def schedule(seed):
+        plan = FaultPlan(seed, [FaultRule("site.x", "error", rate=0.5)])
+        return [plan.decide("site.x") is not None for _ in range(200)]
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)
+
+
+def test_fault_plan_sites_are_independent_streams():
+    plan = FaultPlan(7, [FaultRule("a", "error", rate=0.5),
+                         FaultRule("b", "error", rate=0.5)])
+    seq_a = [plan.decide("a") is not None for _ in range(50)]
+    # Interleaving calls at another site must not perturb a's stream.
+    plan2 = FaultPlan(7, [FaultRule("a", "error", rate=0.5),
+                          FaultRule("b", "error", rate=0.5)])
+    seq_a2 = []
+    for _ in range(50):
+        plan2.decide("b")
+        seq_a2.append(plan2.decide("a") is not None)
+    assert seq_a == seq_a2
+
+
+def test_fault_plan_kinds_and_caps():
+    plan = install_plan(FaultPlan(3, [
+        FaultRule("inj.err", "error", rate=1.0, max_injections=2),
+        FaultRule("inj.slow", "delay", rate=1.0, delay_s=0.01),
+        FaultRule("inj.bad", "corrupt", rate=1.0),
+        FaultRule("pfx.*", "error", rate=1.0),
+    ]))
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            fault_point("inj.err")
+    assert fault_point("inj.err", "through") == "through"  # cap reached
+    t0 = time.perf_counter()
+    assert fault_point("inj.slow", 5) == 5
+    assert time.perf_counter() - t0 >= 0.01
+    out = fault_point("inj.bad", {"q": "abc", "n": 1})
+    assert out["__fault_corrupted__"] and out["q"] == "cba" and out["n"] == 1
+    with pytest.raises(FaultInjected):
+        fault_point("pfx.anything")  # prefix rule
+    assert plan.injections()["inj.err"] == 2
+    assert plan.calls()["inj.err"] == 3
+
+
+def test_fault_injected_is_a_connection_error():
+    # Injections must flow through the transport-error handling the serve
+    # tiers already have (_NET_ERRORS) — no test-only error paths.
+    assert issubclass(FaultInjected, ConnectionError)
+
+
+def test_disabled_fault_point_passthrough_and_overhead():
+    """Tier-1 guard: sites live on production paths unconditionally
+    because the disabled plane is one global read (< 5 us per call)."""
+    payload = {"x": 1}
+    assert fault_point("any.site", payload) is payload
+    n = 10_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fault_point("hot.site")
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 5e-6, f"disabled fault_point costs {best * 1e6:.2f} us"
+
+
+# ------------------------------------------------- serve-tier integration
+def test_expired_deadline_terminates_without_forward(stack, monkeypatch):
+    s, hub, q, store, worker = stack
+    sub = hub.subscribe("sockD")
+    forwards = []
+    monkeypatch.setattr(
+        worker.engine, "run_many",
+        lambda *a, **k: forwards.append("run_many") or [])
+    monkeypatch.setattr(
+        worker.engine, "run",
+        lambda *a, **k: forwards.append("run") or (None, None))
+    # Calendar math, not a duration: a wire stamp issued a minute ago.
+    q.publish(make_job_message(
+        ["img_a.jpg"], "too late", 1, "sockD",
+        deadline={"budget_s": 0.01,
+                  "issued_unix": time.time() - 60}))  # vmtlint: disable=VMT109
+    assert worker.step_batch() == 1  # terminated = reached a final state
+    assert forwards == []  # the engine never dispatched
+    assert q.counts() == {}  # acked away, not dead-lettered
+    frames = _drain(sub)
+    dead = [f for f in frames if f.get("deadline_exceeded")]
+    assert len(dead) == 1 and dead[0]["question"] == "too late"
+
+
+def test_deadline_rides_the_job_body(stack):
+    s, hub, q, store, worker = stack
+    api = ApiServer(q, store, hub, s)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("POST", "/", body=json.dumps({
+            "task_id": 1, "socket_id": "sockW", "question": "q",
+            "image_list": ["img_a.jpg"], "deadline_s": 45.0,
+        }), headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+    finally:
+        api.stop()
+    job = q.claim()
+    assert job.body["deadline"]["budget_s"] == 45.0
+    assert Deadline.from_wire(job.body["deadline"]).remaining_s() > 40.0
+    q.ack(job.id)
+
+
+def test_http_shed_replies_429_with_retry_after(stack):
+    s, hub, q, store, worker = stack
+    s429 = dataclasses.replace(s, admission_max_queue_depth=2,
+                               admission_retry_after_s=3.0)
+    api = ApiServer(q, store, hub, s429)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        body = {"task_id": 1, "socket_id": "x", "question": "q",
+                "image_list": ["img_a.jpg"]}
+        for expect in (200, 200):  # depth 0 → 1 → 2
+            conn.request("POST", "/", body=json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            assert conn.getresponse().status == expect
+        conn.request("POST", "/", body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        shed = json.loads(resp.read())
+        assert resp.status == 429
+        assert resp.getheader("Retry-After") == "3"
+        assert shed["reason"] == "queue_depth"
+        # The shed shows up in the Prometheus exposition.
+        conn.request("GET", "/metrics?format=prometheus")
+        text = conn.getresponse().read().decode()
+        assert 'vmt_shed_total{reason="queue_depth"}' in text
+    finally:
+        api.stop()
+
+
+def test_intake_fault_injection_dead_letters(stack):
+    s, hub, q, store, worker = stack
+    sub = hub.subscribe("sockF")
+    install_plan(FaultPlan(1, [FaultRule("worker.intake", "error")]))
+    q.publish(make_job_message(["img_a.jpg"], "doomed", 1, "sockF"))
+    for _ in range(s.max_delivery_attempts):
+        worker.step_batch()
+    assert q.counts() == {"dead": 1}
+    dead = [f for f in _drain(sub) if "error" in f]
+    assert len(dead) == 1 and "injected fault" in dead[0]["error"]
+
+
+# --------------------------------------------------------- graceful drain
+def test_drain_stops_claiming_when_stop_set(stack):
+    import threading
+
+    s, hub, q, store, worker = stack
+    q.publish(make_job_message(["img_a.jpg"], "later", 1, "sockG"))
+    stop = threading.Event()
+    stop.set()
+    assert worker.step_batch(stop_event=stop) == 0
+    assert q.counts() == {"pending": 1}  # untouched for the next worker
+
+
+def test_abandon_inflight_releases_and_notifies(stack):
+    s, hub, q, store, worker = stack
+    sub = hub.subscribe("sockR")
+    q.publish(make_job_message(["img_a.jpg"], "requeue me", 1, "sockR"))
+    job = worker._claim()
+    assert job is not None and q.counts() == {"inflight": 1}
+    assert worker.abandon_inflight() == 1
+    assert q.counts() == {"pending": 1}
+    frames = [f for f in _drain(sub) if f.get("requeued")]
+    assert len(frames) == 1 and frames[0]["question"] == "requeue me"
+    # release() charged no delivery attempt: the next claim is attempt 1.
+    job2 = q.claim()
+    assert job2.attempts == 1
+    q.ack(job2.id)
+    assert worker.abandon_inflight() == 0  # nothing left in hand

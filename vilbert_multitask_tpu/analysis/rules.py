@@ -1017,11 +1017,94 @@ class PerRowTransferInLoop(Rule):
                         f"or justify the pipelining in the baseline")
 
 
+# --------------------------------------------------------------------- 114
+# Substrings that mark a sleep delay as jittered/randomized. ``backoff_s``
+# is the blessed helper: resilience.RetryPolicy.backoff_s is full-jitter
+# by construction.
+_JITTER_MARKERS = ("random", "uniform", "jitter", "expovariate",
+                   "backoff_s")
+
+
+class NakedRetryLoop(Rule):
+    """An unbounded retry loop: catch + un-jittered sleep, no attempt cap.
+
+    The exact shape ``resilience.RetryPolicy`` exists to replace (and that
+    ``serve/remote.py`` used to hand-roll): ``while True`` around a try/
+    except with a constant or deterministic-exponential ``time.sleep`` —
+    every process that observed the same failure sleeps the same schedule
+    and retries in lockstep (thundering herd), and nothing ever gives up,
+    so a dead dependency pins the loop forever. A bounded ``for`` over
+    attempts is structurally capped and stays clean; so does any delay
+    expression that visibly randomizes (random/uniform/jitter/expovariate
+    or the RetryPolicy ``backoff_s`` helper). Poll loops with a real exit
+    condition (``while not stop.is_set()``) are not retry loops and are
+    never flagged.
+    """
+
+    id = "VMT114"
+    name = "naked-retry-loop"
+    severity = "error"
+    description = ("unbounded `while True` loop catching an exception and "
+                   "time.sleep-ing a constant/un-jittered delay — retries "
+                   "in lockstep forever; use resilience.RetryPolicy "
+                   "(bounded attempts + full jitter)")
+
+    @staticmethod
+    def _is_unbounded(loop: ast.While) -> bool:
+        return (isinstance(loop.test, ast.Constant)
+                and bool(loop.test.value))
+
+    def _jittered(self, ctx: ModuleContext, delay: ast.AST) -> bool:
+        for node in ast.walk(delay):
+            text = ""
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                text = ctx.resolve(node)
+            elif isinstance(node, ast.Call):
+                text = ctx.resolve(node.func)
+            if text and any(m in text.lower() for m in _JITTER_MARKERS):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not (isinstance(loop, ast.While)
+                    and self._is_unbounded(loop)):
+                continue
+            catches = any(
+                isinstance(n, ast.ExceptHandler)
+                for stmt in loop.body for n in ast.walk(stmt))
+            if not catches:
+                continue
+            for stmt in loop.body:
+                for node in ast.walk(stmt):
+                    if not (isinstance(node, ast.Call)
+                            and ctx.resolve(node.func) == "time.sleep"
+                            and node.args):
+                        continue
+                    # Sleeps inside a NESTED bounded loop belong to that
+                    # loop, not this retry loop.
+                    owner = next(
+                        (a for a in ctx.ancestors(node)
+                         if isinstance(a, (ast.For, ast.While))), None)
+                    if owner is not loop and not (
+                            isinstance(owner, ast.While)
+                            and self._is_unbounded(owner)):
+                        continue
+                    if self._jittered(ctx, node.args[0]):
+                        continue
+                    yield self.finding(
+                        ctx, node, "un-jittered time.sleep in an unbounded "
+                        "`while True` retry loop — every worker that saw "
+                        "the failure retries on the same schedule, forever; "
+                        "use resilience.RetryPolicy.call (bounded attempts, "
+                        "full jitter, process retry budget)")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
          LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
-         PerRowTransferInLoop]
+         PerRowTransferInLoop, NakedRetryLoop]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

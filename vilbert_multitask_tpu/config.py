@@ -408,6 +408,32 @@ class ServingConfig:
     # ``Authorization: Bearer <token>`` (admin.html prompts for it).
     # None → open — acceptable only on the loopback default bind.
     admin_token: str | None = None
+    # --- resilience/ knobs (see ARCHITECTURE.md "Resilience") ---
+    # Time budget minted at POST / and carried in the job body; the worker
+    # and engine terminate expired jobs with a terminal push instead of
+    # dispatching a forward. None disables deadlines; a per-request
+    # "deadline_s" in the submit payload overrides the default.
+    default_deadline_s: float | None = 300.0
+    # Admission control at the HTTP door: shed with 429 + Retry-After when
+    # pending+inflight depth, or the oldest pending job's age, crosses a
+    # threshold (0 disables that signal).
+    admission_max_queue_depth: int = 512
+    admission_max_queue_age_s: float = 120.0
+    admission_retry_after_s: float = 2.0
+    # Shared RetryPolicy shape for the remote-worker transport (full
+    # jitter; the per-process RetryBudget bounds total retry volume).
+    retry_max_attempts: int = 5
+    retry_base_delay_s: float = 0.5
+    retry_max_delay_s: float = 30.0
+    # CircuitBreaker over the remote transport: trip after
+    # breaker_failure_threshold failures within breaker_window_s, probe
+    # again after breaker_reset_timeout_s.
+    breaker_failure_threshold: int = 5
+    breaker_window_s: float = 30.0
+    breaker_reset_timeout_s: float = 10.0
+    # Graceful drain: how long stop() waits for the worker to finish
+    # in-flight jobs before releasing them back to the queue.
+    drain_grace_s: float = 10.0
 
 
 @dataclasses.dataclass(frozen=True)

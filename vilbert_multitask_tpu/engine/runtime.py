@@ -63,6 +63,8 @@ from vilbert_multitask_tpu.features.pipeline import (
 from vilbert_multitask_tpu.features.store import FeatureStore
 from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks, ViLBertOutput
 from vilbert_multitask_tpu.parallel import sharding as shd
+from vilbert_multitask_tpu.resilience import CircuitBreaker, DeadlineExceeded
+from vilbert_multitask_tpu.resilience.faults import fault_point
 from vilbert_multitask_tpu import assets, obs
 
 # XLA compiles are the dominant "why did THIS request take 4 s" answer;
@@ -219,6 +221,15 @@ class InferenceEngine:
         # _fallback_lock may be held when taking this one, never the
         # reverse — the builders take only _compile_lock.
         self._compile_lock = threading.Lock()
+        # Breaker over the forward funnel (_call_forward): sustained device
+        # failures (dead tunnel, OOM loop) fail jobs fast toward the queue's
+        # dead-letter path instead of stalling the worker on each one. The
+        # threshold is deliberately laxer than the transport breaker's —
+        # one-off runtime errors (worst case: one bad request per window)
+        # must not poison a shared engine.
+        self._breaker = CircuitBreaker(
+            name="engine.forward", failure_threshold=8, window_s=60.0,
+            reset_timeout_s=15.0)
         # Device input cache: encoded region tensors for content-stable
         # (store-backed) images, pinned in HBM after first use — the input
         # analogue of the one-time param device_put above. Rows live in the
@@ -477,7 +488,29 @@ class InferenceEngine:
 
     def _call_forward(self, bucket: int, collect_attention: bool, *args,
                       rows: bool = False):
-        """All device forwards funnel through here — it's the Pallas probe.
+        """All device forwards funnel through here — resilience gate first.
+
+        ``fault_point("engine.dispatch")`` lets a chaos plan flap/slow the
+        device path; the breaker turns SUSTAINED dispatch failures (dead
+        tunnel, OOM loop) into fast fails so jobs drain toward dead-letter
+        instead of each stalling the worker. A dispatch that degrades to
+        XLA and then succeeds counts as a success — degrade is recovery,
+        not failure.
+        """
+        fault_point("engine.dispatch")
+        self._breaker.preflight()
+        try:
+            result = self._dispatch_forward(bucket, collect_attention,
+                                            *args, rows=rows)
+        except Exception:
+            self._breaker.record_failure()
+            raise
+        self._breaker.record_success()
+        return result
+
+    def _dispatch_forward(self, bucket: int, collect_attention: bool, *args,
+                          rows: bool = False):
+        """The Pallas probe under the resilience gate.
 
         The kernels are default-on; if Mosaic rejects them on this backend
         (new TPU generation, toolchain skew), the engine degrades itself to
@@ -831,8 +864,19 @@ class InferenceEngine:
                  req.cache_keys[i] if req.cache_keys is not None else None)
                 for i in range(req.n_images)]
 
-    def run(self, req: PreparedRequest, *, collect_attention: bool = False):
-        """Device forward for a prepared request → (output, decoded result)."""
+    def run(self, req: PreparedRequest, *, collect_attention: bool = False,
+            deadline=None):
+        """Device forward for a prepared request → (output, decoded result).
+
+        ``deadline`` (a :class:`resilience.Deadline`) is checked at entry:
+        dispatching a forward for a client that already gave up is the most
+        expensive possible no-op, so an expired budget raises
+        :class:`DeadlineExceeded` before any device work.
+        """
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(
+                f"deadline expired {-deadline.remaining_s():.2f}s before "
+                f"dispatch (task {req.spec.task_id})")
         text = dict(
             input_ids=req.text.input_ids, segment_ids=req.text.segment_ids,
             input_mask=req.text.input_mask, task_ids=req.task_ids,
@@ -873,7 +917,7 @@ class InferenceEngine:
 
     def run_many(
         self, reqs: Sequence[PreparedRequest], *,
-        chunk_rows: Optional[int] = None,
+        chunk_rows: Optional[int] = None, deadline=None,
     ) -> List[dec.TaskResult]:
         """Cross-task micro-batching: many single-image requests, ONE forward.
 
@@ -890,6 +934,13 @@ class InferenceEngine:
         """
         if not reqs:
             return []
+        # Entry-only deadline check (batches carry per-job deadlines — the
+        # worker sheds expired members BEFORE packing; this guards callers
+        # that pass one shared budget for the whole batch, e.g. evals).
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(
+                f"deadline expired {-deadline.remaining_s():.2f}s before "
+                f"batch dispatch ({len(reqs)} requests)")
         # Oversized batches split into max-bucket chunks rather than erroring
         # (callers pick batch sizes; compiled buckets cap per-forward rows).
         # Bounded pipelining: up to _MAX_INFLIGHT_CHUNKS chunks dispatch

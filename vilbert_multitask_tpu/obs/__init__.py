@@ -53,12 +53,37 @@ __all__ = [
     "log_buckets", "percentile",
     "PROMETHEUS_CONTENT_TYPE", "chrome_trace", "dump_trace",
     "render_prometheus", "start_profile", "stop_profile",
+    "SHED_COUNTER", "RETRY_COUNTER", "BREAKER_GAUGE", "DEADLINE_SLACK",
 ]
 
 SPAN_HISTOGRAM = REGISTRY.histogram(
     "vmt_span_ms",
     "Span durations by span name and task (ms).",
     labelnames=("name", "task"),
+)
+
+# Resilience instruments (resilience/ policy plane). Defined here so the
+# policy module stays import-light and every exporter sees them.
+SHED_COUNTER = REGISTRY.counter(
+    "vmt_shed_total",
+    "Requests/jobs shed before doing work, by reason "
+    "(queue_depth, queue_age, deadline).",
+    labelnames=("reason",),
+)
+RETRY_COUNTER = REGISTRY.counter(
+    "vmt_retries_total",
+    "Retry attempts actually slept for, by call site.",
+    labelnames=("site",),
+)
+BREAKER_GAUGE = REGISTRY.gauge(
+    "vmt_breaker_state",
+    "Circuit-breaker state: 0 closed, 1 half-open, 2 open.",
+    labelnames=("breaker",),
+)
+DEADLINE_SLACK = REGISTRY.histogram(
+    "vmt_deadline_slack_ms",
+    "Remaining deadline budget when the worker picked the job up (ms).",
+    labelnames=("task",),
 )
 
 

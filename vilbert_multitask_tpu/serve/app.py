@@ -141,22 +141,34 @@ class ServeApp:
             kernel_fallback=self.engine.kernel_fallback,
         )
 
-    def start(self) -> None:
+    def start(self, worker: bool = True) -> None:
+        """Boot the tiers; ``worker=False`` serves HTTP/ws only (an external
+        worker — serve/remote.py, or the chaos soak's scripted one — drains
+        the queue instead)."""
         # Websocket first: /config must never advertise an unbound ws port
         # (the browser caches it and would reconnect to ws://host:0 forever).
         self.ws.start()
         self.api.ws_port = self.ws.bound_port
         self.http_port = self.api.start()
-        self._worker_thread = threading.Thread(
-            target=self.worker.run_forever,
-            kwargs={"stop_event": self._stop},
-            daemon=True, name="serve-worker")
-        self._worker_thread.start()
+        if worker:
+            self._worker_thread = threading.Thread(
+                target=self.worker.run_forever,
+                kwargs={"stop_event": self._stop},
+                daemon=True, name="serve-worker")
+            self._worker_thread.start()
 
     def stop(self) -> None:
+        """Graceful drain: signal the worker to stop CLAIMING, give it
+        ``drain_grace_s`` to finish jobs in hand, then release anything
+        still claimed back to pending (terminal "requeued" push, no
+        delivery attempt charged) before tearing the web tiers down."""
         self._stop.set()
         if self._worker_thread:
-            self._worker_thread.join(timeout=10)
+            self._worker_thread.join(timeout=self.cfg.serving.drain_grace_s)
+        # After the join (clean or timed out): anything still tracked as
+        # in-flight goes back to the queue for the next worker. A clean
+        # drain finds the set empty — at-least-once makes this idempotent.
+        self.worker.abandon_inflight()
         self.api.stop()
         self.ws.stop()
 
@@ -195,10 +207,19 @@ def main(argv=None) -> None:
     s = app.cfg.serving
     print(f"http://{s.http_host}:{app.http_port}  "
           f"ws://{s.http_host}:{app.ws.bound_port}  queue={s.queue_db_path}")
+    # Graceful drain on SIGTERM (the orchestrator's stop signal): stop
+    # claiming, finish in-flight within drain_grace_s, release the rest
+    # with a terminal push, exit 0. Ctrl-C takes the same path.
+    import signal
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: stop.set())
     try:
-        threading.Event().wait()
+        stop.wait()
     except KeyboardInterrupt:
-        app.stop()
+        pass
+    print(f"draining (grace {s.drain_grace_s:.0f}s)...")
+    app.stop()
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import ServingConfig, TASK_REGISTRY
+from vilbert_multitask_tpu.resilience import AdmissionController, Deadline
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, make_job_message
@@ -62,6 +63,13 @@ class ApiServer:
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
+        # Shed-before-enqueue (resilience/): overloaded submits get a fast
+        # 429 + Retry-After instead of joining a backlog they'd time out in.
+        self.admission = AdmissionController(
+            max_queue_depth=self.serving.admission_max_queue_depth,
+            max_queue_age_s=self.serving.admission_max_queue_age_s,
+            retry_after_s=self.serving.admission_retry_after_s,
+        )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -86,6 +94,18 @@ class ApiServer:
             images = list(payload.get("image_list", []))
         except (KeyError, TypeError, ValueError):
             return 400, {"error": "need task_id, socket_id, question, image_list"}
+        decision = self._admission_decision()
+        if not decision.admitted:
+            return 429, {
+                "error": "overloaded; retry later",
+                "reason": decision.reason,
+                "retry_after_s": decision.retry_after_s,
+            }
+        try:
+            budget = payload.get("deadline_s", self.serving.default_deadline_s)
+            budget = None if budget is None else float(budget)
+        except (TypeError, ValueError):
+            return 400, {"error": "deadline_s must be a number"}
         spec = TASK_REGISTRY.get(task_id)
         if spec is None:
             return 400, {"error": f"unknown task_id {task_id}"}
@@ -105,9 +125,20 @@ class ApiServer:
                 # any other truthy value → compact summary.
                 collect_attention=("full" if collect == "full"
                                    else bool(collect)),
-                trace_id=trace_id))
+                trace_id=trace_id,
+                # The deadline is minted HERE — queueing time counts against
+                # the budget, so a job stuck behind a backlog expires instead
+                # of burning a forward for a long-gone client.
+                deadline=(Deadline(budget).to_wire()
+                          if budget and budget > 0 else None)))
         sp.set(task_id=task_id, job_id=job_id, n_images=len(images))
         return 200, {"job_id": job_id, "task": spec.name}
+
+    def _admission_decision(self):
+        counts = self.queue.counts()
+        depth = counts.get("pending", 0) + counts.get("inflight", 0)
+        return self.admission.admit(
+            depth=depth, oldest_age_s=self.queue.oldest_pending_age_s())
 
     def task_details(self, task_id: int) -> Tuple[int, Dict[str, Any]]:
         task = self.store.get_task(task_id)
@@ -174,11 +205,14 @@ class ApiServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _json(self, code: int, payload: Dict[str, Any]) -> None:
+            def _json(self, code: int, payload: Dict[str, Any],
+                      headers: Optional[Dict[str, str]] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -396,7 +430,13 @@ class ApiServer:
                     except json.JSONDecodeError:
                         self._json(400, {"error": "invalid JSON"})
                         return
-                    self._json(*api.submit_job(payload))
+                    code, body = api.submit_job(payload)
+                    headers = None
+                    if code == 429:
+                        # RFC 9110 §10.2.3: Retry-After in whole seconds.
+                        headers = {"Retry-After": str(max(1, int(round(
+                            body.get("retry_after_s", 1)))))}
+                    self._json(code, body, headers=headers)
                 elif path == "/upload_image":
                     self._handle_upload(raw, ctype)
                 elif path.startswith("/worker/"):

@@ -24,6 +24,8 @@ import threading
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
+from vilbert_multitask_tpu.resilience.faults import FaultInjected, fault_point
+
 
 class PushHub:
     """socket_id → subscriber queues; publish is non-blocking."""
@@ -50,6 +52,12 @@ class PushHub:
     def publish(self, socket_id: str, payload: Dict[str, Any]) -> int:
         """Send to every subscriber of the group; slow consumers drop oldest
         (the reference's Redis groups drop silently on backpressure too)."""
+        try:
+            payload = fault_point("push.publish", payload)
+        except FaultInjected:
+            # Push is best-effort by contract — an injected fault here
+            # models a dropped frame, never an error into the job cycle.
+            return 0
         with self._lock:
             subs = list(self._groups.get(socket_id, ()))
         for q in subs:
@@ -136,6 +144,17 @@ class WebSocketBridge:
             await self._stop.wait()
 
     def start(self) -> None:
+        try:
+            import websockets  # noqa: F401
+        except ImportError:
+            # No websockets lib in this environment: degrade to HTTP-only
+            # serving instead of failing boot. In-process consumers (result
+            # polling, the soak's direct hub subscription) still get every
+            # frame — only the browser bridge is absent. bound_port=0 keeps
+            # /config well-formed.
+            self.bound_port = 0
+            return
+
         def run():
             self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)

@@ -22,6 +22,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Sequence
 
+from vilbert_multitask_tpu.resilience.faults import fault_point
+
 
 @dataclass
 class Job:
@@ -66,6 +68,7 @@ class DurableQueue:
     # ---------------------------------------------------------------- producer
     def publish(self, body: Dict[str, Any]) -> int:
         """Persist one job (the reference's delivery_mode=2, sender.py:30-31)."""
+        body = fault_point("queue.publish", body)
         with self._conn() as c:
             cur = c.execute(
                 "INSERT INTO jobs (queue, body, created_at) VALUES (?, ?, ?)",
@@ -86,6 +89,7 @@ class DurableQueue:
         between claim and ack (reference relies on connection-drop redelivery,
         worker.py:653-655).
         """
+        fault_point("queue.claim")
         now = time.time()
         with self._conn() as c:
             c.execute("BEGIN IMMEDIATE")
@@ -171,6 +175,21 @@ class DurableQueue:
             ).fetchall()
         return {status: n for status, n in rows}
 
+    def oldest_pending_age_s(self) -> Optional[float]:
+        """Age of the oldest pending job (None when the queue is empty) —
+        the admission controller's queue-age overload signal."""
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT MIN(created_at) FROM jobs "
+                "WHERE queue=? AND status='pending'",
+                (self.queue_name,),
+            ).fetchone()
+        if row is None or row[0] is None:
+            return None
+        # Age of a persisted wall-clock stamp (possibly written by another
+        # process) — monotonic clocks cannot be compared cross-process.
+        return max(0.0, time.time() - row[0])  # vmtlint: disable=VMT109
+
     def dead_jobs(self) -> list[Job]:
         with self._conn() as c:
             rows = c.execute(
@@ -184,7 +203,8 @@ class DurableQueue:
 def make_job_message(image_paths, question: str, task_id: int,
                      socket_id: str, *,
                      collect_attention: "bool | str" = False,
-                     trace_id: "str | None" = None
+                     trace_id: "str | None" = None,
+                     deadline: "Dict[str, float] | None" = None
                      ) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
     list of absolute paths, ``question`` the (pre-lowercased) query.
@@ -209,4 +229,8 @@ def make_job_message(image_paths, question: str, task_id: int,
         # Cross-thread span correlation: the worker re-enters this trace
         # (obs.trace_scope) so submit → claim → infer → push share one id.
         msg["trace_id"] = trace_id
+    if deadline:
+        # Deadline.to_wire(): the worker re-anchors the remaining budget to
+        # its own monotonic clock and sheds expired jobs before dispatch.
+        msg["deadline"] = deadline
     return msg

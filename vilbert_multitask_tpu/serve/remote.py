@@ -28,40 +28,51 @@ from __future__ import annotations
 import argparse
 import json
 import logging
-import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence
 
+from vilbert_multitask_tpu.resilience import CircuitBreaker, RetryPolicy
+from vilbert_multitask_tpu.resilience.faults import fault_point
 from vilbert_multitask_tpu.serve.queue import Job
 
 log = logging.getLogger(__name__)
 
 # Transient transport failures worth retrying (web-host restart, TCP blip).
+# CircuitOpenError and FaultInjected both subclass ConnectionError, so a
+# breaker-shed or injected call takes the same handling as real loss.
 _NET_ERRORS = (urllib.error.URLError, ConnectionError, TimeoutError, OSError)
 
 
 class WorkerApiClient:
     """JSON-over-HTTP client for the web host's ``/worker/*`` endpoints.
 
-    Network errors retry with exponential backoff — a web-host restart or a
-    TCP blip must not kill a TPU worker that took minutes to warm up. HTTP
-    *status* errors (401 bad token, 400 bad request) do NOT retry: they are
-    deterministic and the caller needs to see them.
+    Network errors retry through the shared :class:`RetryPolicy` — full
+    jitter, so N workers that lost the web host together do NOT hammer it
+    back in lockstep when it returns (the old hand-rolled loop here slept
+    ``base * 2**attempt`` un-jittered: a thundering herd). A web-host
+    restart or TCP blip must not kill a TPU worker that took minutes to
+    warm up; the :class:`CircuitBreaker` makes a DEAD web host cheap to
+    wait out (fail-fast instead of a connect timeout per call). HTTP
+    *status* errors (401 bad token, 400 bad request) do NOT retry: they
+    are deterministic and the caller needs to see them.
     """
 
     def __init__(self, base_url: str, *, token: Optional[str] = None,
-                 timeout_s: float = 30.0, retries: int = 5,
-                 backoff_s: float = 0.5):
+                 timeout_s: float = 30.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout_s = timeout_s
-        self.retries = retries
-        self.backoff_s = backoff_s
+        self.retry = retry or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker(name="remote.transport")
 
     def post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
-        last: Optional[BaseException] = None
-        for attempt in range(self.retries):
+        def attempt() -> Dict[str, Any]:
+            # Fault site BEFORE the request: an injected flap models the
+            # connection dying, never a half-applied server-side effect.
+            fault_point("remote.post")
             req = urllib.request.Request(
                 self.base_url + path,
                 data=json.dumps(payload).encode(),
@@ -72,20 +83,14 @@ class WorkerApiClient:
                 },
                 method="POST",
             )
-            try:
-                with urllib.request.urlopen(
-                        req, timeout=self.timeout_s) as resp:
-                    return json.loads(resp.read() or b"{}")
-            except urllib.error.HTTPError:
-                raise  # deterministic: bad token / bad request
-            except _NET_ERRORS as e:
-                last = e
-                if attempt < self.retries - 1:
-                    delay = self.backoff_s * (2 ** attempt)
-                    log.warning("POST %s failed (%s); retry in %.1fs",
-                                path, e, delay)
-                    time.sleep(delay)
-        raise last  # type: ignore[misc]
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        return self.retry.call(
+            attempt, site="remote.post", retry_on=_NET_ERRORS,
+            # HTTPError subclasses URLError: without this it would retry.
+            no_retry=(urllib.error.HTTPError,), breaker=self.breaker)
 
 
 class RemoteQueue:
@@ -186,7 +191,16 @@ def build_remote_worker(base_url: str, *, cfg=None, engine=None,
     from vilbert_multitask_tpu.serve.worker import ServeWorker
 
     cfg = cfg or FrameworkConfig()
-    client = WorkerApiClient(base_url, token=token)
+    s = cfg.serving
+    client = WorkerApiClient(
+        base_url, token=token,
+        retry=RetryPolicy(max_attempts=s.retry_max_attempts,
+                          base_delay_s=s.retry_base_delay_s,
+                          max_delay_s=s.retry_max_delay_s),
+        breaker=CircuitBreaker(name="remote.transport",
+                               failure_threshold=s.breaker_failure_threshold,
+                               window_s=s.breaker_window_s,
+                               reset_timeout_s=s.breaker_reset_timeout_s))
     if engine is None:
         params = None
         if checkpoint_path is not None:

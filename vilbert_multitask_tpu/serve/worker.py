@@ -15,6 +15,7 @@ the client's websocket group — with the §2.4 parity traps fixed:
 from __future__ import annotations
 
 import os
+import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional
@@ -22,6 +23,8 @@ from typing import Any, Dict, List, Optional
 from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import ServingConfig, TASK_REGISTRY
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine
+from vilbert_multitask_tpu.resilience import Deadline, DeadlineExceeded
+from vilbert_multitask_tpu.resilience.faults import fault_point
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.metrics import Metrics
 from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
@@ -104,6 +107,10 @@ class ServeWorker:
         self.hub = hub
         self.serving = serving or ServingConfig()
         self.metrics = metrics or Metrics()
+        # Claimed-but-unfinished jobs, for graceful drain: stop() releases
+        # these back to the queue (no attempt charged) and tells the client.
+        self._inflight_lock = threading.Lock()
+        self._inflight: Dict[int, Job] = {}
 
     # ------------------------------------------------------------- job cycle
     def _intake(self, job: Job):
@@ -112,6 +119,7 @@ class ServeWorker:
         t0 is captured before feature I/O so solo and batched paths record
         the same latency definition in :class:`Metrics`.
         """
+        fault_point("worker.intake")
         body = job.body
         t0 = time.perf_counter()
         task_id = int(body["task_id"])  # reference eval()s this str; we don't
@@ -152,8 +160,9 @@ class ServeWorker:
             collect = job.body.get("collect_attention", False)
             with obs.span("worker.infer",
                           task_id=job.body.get("task_id", "")):
-                out, result = self.engine.run(prepared,
-                                              collect_attention=bool(collect))
+                out, result = self.engine.run(
+                    prepared, collect_attention=bool(collect),
+                    deadline=self._deadline_of(job))
             attention = None
             if collect:
                 attention = _attention_summary(out)
@@ -174,7 +183,45 @@ class ServeWorker:
                 "worker.claim", t0, time.perf_counter() - t0,
                 trace_id=job.body.get("trace_id"), job_id=job.id,
                 attempts=job.attempts)
+            with self._inflight_lock:
+                self._inflight[job.id] = job
         return job
+
+    def _untrack(self, job_id: int) -> None:
+        with self._inflight_lock:
+            self._inflight.pop(job_id, None)
+
+    # ------------------------------------------------------------- deadlines
+    @staticmethod
+    def _deadline_of(job: Job) -> Optional[Deadline]:
+        return Deadline.from_wire(job.body.get("deadline"))
+
+    def _check_deadline(self, job: Job) -> bool:
+        """True if the job's deadline already expired (job terminated)."""
+        dl = self._deadline_of(job)
+        if dl is None:
+            return False
+        obs.DEADLINE_SLACK.observe(
+            max(dl.remaining_s(), 0.0) * 1e3,
+            task=str(job.body.get("task_id", "")))
+        if not dl.expired():
+            return False
+        self._expire_job(job)
+        return True
+
+    def _expire_job(self, job: Job) -> None:
+        """Terminate an expired job: terminal push + ack (the client gave
+        up waiting; a forward would be pure waste). Ack, not nack — the
+        outcome is final, not retryable."""
+        obs.SHED_COUNTER.inc(reason="deadline")
+        log_to_terminal(
+            self.hub, job.body.get("socket_id", ""),
+            {"terminal": "Deadline exceeded before the job could be "
+                         "served; not retried.",
+             "deadline_exceeded": True,
+             "question": job.body.get("question", "")})
+        self.queue.ack(job.id)
+        self._untrack(job.id)
 
     def step(self) -> Optional[str]:
         """Claim and run one job. Returns 'acked'/'failed'/None."""
@@ -190,7 +237,8 @@ class ServeWorker:
             self.metrics.record_failure()
 
     # ------------------------------------------------------- micro-batching
-    def step_batch(self, max_jobs: Optional[int] = None) -> int:
+    def step_batch(self, max_jobs: Optional[int] = None, *,
+                   stop_event=None) -> int:
         """Drain up to ``max_jobs`` queued jobs and serve the packable ones
         through batched forwards (engine.run_many — mixed image counts
         share chunks, so NLVR2 pairs, retrieval candidate sets, and
@@ -211,9 +259,16 @@ class ServeWorker:
         done = 0
         failed_ids: set = set()
         while len(packable) < max_jobs:
+            if stop_event is not None and stop_event.is_set():
+                # Graceful drain: stop CLAIMING; jobs already in hand below
+                # still finish (stop() waits drain_grace_s for them).
+                break
             job = self._claim(exclude=failed_ids)
             if job is None:
                 break
+            if self._check_deadline(job):
+                done += 1  # terminated with a terminal push — a final state
+                continue
             if job.body.get("collect_attention"):
                 # attention maps are a per-request forward flag: serve solo
                 if self.step_one(job) == "acked":
@@ -232,6 +287,17 @@ class ServeWorker:
             except Exception:
                 self._fail_job(job)
                 failed_ids.add(job.id)
+        if not packable:
+            return done
+        # Deadlines can lapse during intake (feature I/O) — re-check so the
+        # batched forward never carries an already-dead request.
+        still_live = []
+        for entry in packable:
+            if self._check_deadline(entry[0]):
+                done += 1
+            else:
+                still_live.append(entry)
+        packable = still_live
         if not packable:
             return done
         try:
@@ -261,6 +327,7 @@ class ServeWorker:
                 with obs.trace_scope(job.body.get("trace_id")):
                     self._finish_job(job, qa_id, prepared, result, t0)
                 self.queue.ack(job.id)
+                self._untrack(job.id)
                 done += 1
             except Exception:
                 self._fail_job(job)
@@ -321,30 +388,63 @@ class ServeWorker:
         """nack + telemetry; returns 'requeued' or 'dead'."""
         self.metrics_failure_for(job)
         status = self.queue.nack(job.id)
+        self._untrack(job.id)
         if status == "dead":
             log_to_terminal(
                 self.hub, job.body.get("socket_id", ""),
                 {"terminal": "Job failed permanently.",
-                 "error": traceback.format_exc(limit=3)})
+                 "error": traceback.format_exc(limit=3),
+                 "question": job.body.get("question", "")})
         return "requeued" if status == "pending" else status
 
     def step_one(self, job: Job) -> str:
         """Run one already-claimed job solo (ack/nack included).
 
-        Returns 'acked', 'requeued', or 'dead'.
+        Returns 'acked', 'requeued', 'dead', or 'deadline'.
         """
+        if self._check_deadline(job):
+            return "deadline"
         try:
             self.process_job(job)
+        except DeadlineExceeded:
+            # The engine declined to dispatch — terminate, don't retry.
+            self._expire_job(job)
+            return "deadline"
         except Exception:
             return self._fail_job(job)
         self.queue.ack(job.id)
+        self._untrack(job.id)
         return "acked"
+
+    def abandon_inflight(self) -> int:
+        """Graceful-drain tail: release every still-claimed job back to
+        pending (no delivery attempt charged — release(), not nack()) and
+        tell each client its job was requeued, not lost. Returns the count.
+
+        At-least-once delivery makes this safe to call even for jobs that
+        actually completed a moment ago: release() only touches rows still
+        in 'inflight'.
+        """
+        with self._inflight_lock:
+            abandoned = list(self._inflight.values())
+            self._inflight.clear()
+        for job in abandoned:
+            self.queue.release(job.id)
+            log_to_terminal(
+                self.hub, job.body.get("socket_id", ""),
+                {"terminal": "Server draining; job requeued for the next "
+                             "worker.",
+                 "requeued": True,
+                 "question": job.body.get("question", "")})
+        return len(abandoned)
 
     def run_forever(self, *, poll_interval_s: float = 0.05,
                     stop_event=None, batch_jobs: Optional[int] = None) -> None:
         """The consume loop (reference worker.py:672-673), micro-batched;
         ``batch_jobs`` defaults to the engine's largest compiled row bucket
-        (see step_batch)."""
+        (see step_batch). ``stop_event`` doubles as the drain signal:
+        step_batch stops claiming the moment it is set, so in-hand work
+        finishes and the loop exits clean."""
         while stop_event is None or not stop_event.is_set():
-            if self.step_batch(batch_jobs) == 0:
+            if self.step_batch(batch_jobs, stop_event=stop_event) == 0:
                 time.sleep(poll_interval_s)

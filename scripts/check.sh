@@ -28,8 +28,15 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 
 echo "== chaos smoke (seeded FaultPlan, no-lost-jobs invariant) =="
 # Short end-to-end soak under injected faults: every submitted job must
-# reach exactly one terminal state (result / dead-letter / deadline push).
+# reach exactly one terminal state (result / dead-letter / deadline push),
+# and the flight recorder must capture an injected fault's trace.
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --chaos --jobs 15 \
   --out /tmp/CHAOS_SOAK.json || fail=1
+
+echo "== SLO smoke (live-health plane answers under load) =="
+# Boot → synthetic load → /debug/slo parses with every SLO evaluated
+# (both burn windows) and /healthz reports ready.
+JAX_PLATFORMS=cpu python scripts/slo_smoke.py \
+  --out /tmp/SLO_SMOKE.json || fail=1
 
 exit "$fail"

@@ -64,6 +64,12 @@ def _build_cfg(root: str, full: bool):
             results_db_path=os.path.join(root, "results.sqlite3"),
             media_root=os.path.join(root, "media"),
             http_port=0, ws_port=0,
+            # Live-health plane tuned for a short run: fast sampler ticks,
+            # and every trigger event dumps a bundle (the chaos acceptance
+            # bar reads the injected fault's bundle back).
+            sampler_cadence_s=0.25,
+            recorder_min_interval_s=0.0,
+            recorder_max_bundles=64,
         ),
     )
 
@@ -174,7 +180,11 @@ def main(argv=None) -> int:
     except ImportError:
         connect = None
 
-    from vilbert_multitask_tpu.obs import Histogram, percentile
+    from vilbert_multitask_tpu.obs import (
+        DEADLINE_SLACK,
+        Histogram,
+        percentile,
+    )
     from vilbert_multitask_tpu.resilience import clear_plan, install_plan
     from vilbert_multitask_tpu.serve.app import ServeApp
 
@@ -296,6 +306,17 @@ def main(argv=None) -> int:
         wstop.set()
         if wthread is not None:
             wthread.join(timeout=30)
+    # The SLO verdict is read off the live endpoint BEFORE the drain — the
+    # same JSON an operator's probe would see while the burst was served.
+    try:
+        conn.request("GET", "/debug/slo")
+        body = json.loads(conn.getresponse().read())
+        slo_verdict = {
+            "worst": body.get("worst"),
+            "states": {r["slo"]: r["state"] for r in body.get("slos", [])},
+        }
+    except Exception as e:  # degraded report beats a crashed soak
+        slo_verdict = {"error": repr(e)}
     app.stop()
 
     # Same histogram + percentile code as serve/metrics and bench — the
@@ -330,7 +351,15 @@ def main(argv=None) -> int:
         # the metrics of whichever worker actually served).
         "tasks_served": sorted(
             int(k) for k in worker.metrics.snapshot()["by_task"]),
+        "slo_verdict": slo_verdict,
     }
+    # Deadline headroom under load: how much budget each claimed job had
+    # left when the worker picked it up (worker.py observes this per claim).
+    slack = DEADLINE_SLACK.all_samples()
+    report["deadline_slack_ms_p50"] = (round(percentile(slack, 0.5), 1)
+                                       if slack else None)
+    report["deadline_slack_ms_p95"] = (round(percentile(slack, 0.95), 1)
+                                       if slack else None)
     if args.chaos:
         state_counts: dict = {}
         for state in terminals.values():
@@ -338,6 +367,29 @@ def main(argv=None) -> int:
         no_job_lost = bool(ok and len(terminals) == args.jobs)
         exactly_one = not dup_terminals
         faulted = sorted(s for s, n in plan.injections().items() if n > 0)
+        # Flight-recorder acceptance: app.stop() closed the recorder, so
+        # every triggered bundle is flushed. At least one bundle must be a
+        # fault_injected postmortem whose detail carries the fault's
+        # trace_id AND whose captured span window contains that trace —
+        # i.e. the recorder binds the incident to the request that hit it.
+        bundles = app.recorder.bundles()
+        fault_bundle = None
+        trace_in_spans = False
+        for path in bundles:
+            try:
+                with open(path) as f:
+                    b = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if b.get("event") != "fault_injected":
+                continue
+            tid = (b.get("detail") or {}).get("trace_id")
+            if not tid:
+                continue  # untraced site (e.g. the claim poll) — keep looking
+            if tid in {s.get("trace_id") for s in b.get("spans", [])}:
+                fault_bundle = os.path.basename(path)
+                trace_in_spans = True
+                break
         report["chaos"] = {
             "seed": args.seed,
             "injections": plan.injections(),
@@ -347,12 +399,19 @@ def main(argv=None) -> int:
             "no_job_lost": no_job_lost,
             "exactly_one_terminal": exactly_one,
             "duplicates": dup_terminals,
+            "flight_recorder": {
+                "bundles": len(bundles),
+                "fault_bundle": fault_bundle,
+                "fault_trace_in_spans": trace_in_spans,
+            },
         }
-        # Chaos acceptance: faults actually fired at ≥3 sites, and every
+        # Chaos acceptance: faults actually fired at ≥3 sites, every
         # submit reached exactly one terminal state (result, dead-letter,
         # or deadline push) — dead-letters are an ACCEPTED outcome under
-        # injected intake faults, so all_completed is not the bar here.
-        verdict = no_job_lost and exactly_one and len(faulted) >= 3
+        # injected intake faults, so all_completed is not the bar here —
+        # and the flight recorder captured an injected fault's trace.
+        verdict = (no_job_lost and exactly_one and len(faulted) >= 3
+                   and trace_in_spans)
     else:
         verdict = report["all_completed"]
     with open(args.out, "w") as f:

@@ -1,0 +1,120 @@
+"""SLO smoke: boot the serving stack, push synthetic load, and verify the
+live-health plane answers — ``/debug/slo`` parses, every configured SLO is
+evaluated with both burn windows, and ``/healthz`` reports ready.
+
+This is the check.sh gate for the observability plane itself: a wiring
+regression (an SLO not built, the evaluator not reached from the debug
+endpoint, readiness stuck in "booting") fails here in seconds, without
+waiting for a paging incident to reveal it.
+
+Usage: python scripts/slo_smoke.py [--jobs 6] [--out SLO_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import queue as queue_mod
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+EXPECTED_SLOS = {"availability", "e2e_latency", "deadline_slack"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--jobs", type=int, default=6)
+    p.add_argument("--out", default="SLO_SMOKE.json")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # Same tiny stack as the soak — one boot recipe, two gates.
+    from serve_soak import _build_cfg, _make_features
+
+    from vilbert_multitask_tpu.serve.app import ServeApp
+
+    root = tempfile.mkdtemp(prefix="slo_smoke_")
+    cfg = _build_cfg(root, full=False)
+    feat = _make_features(root, cfg.model.v_feature_size)
+    app = ServeApp(cfg, feature_root=feat)
+    app.warm()
+    app.start()
+
+    checks: dict = {}
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                          timeout=30)
+        # Synthetic load: completed requests give the latency/availability
+        # SLOs real events to count in their windows.
+        sock = "slo-smoke"
+        sub = app.hub.subscribe(sock)
+        for i in range(args.jobs):
+            body = json.dumps({
+                "task_id": 1, "socket_id": sock,
+                "question": f"what is in image number {i}",
+                "image_list": ["img_0.jpg"],
+            })
+            conn.request("POST", "/", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            resp.read()
+        results = 0
+        deadline = time.monotonic() + 120
+        while results < args.jobs and time.monotonic() < deadline:
+            try:
+                frame = sub.get(timeout=5)
+            except queue_mod.Empty:
+                continue
+            if "result" in frame:
+                results += 1
+        checks["results"] = results
+
+        conn.request("GET", "/debug/slo")
+        slo = json.loads(conn.getresponse().read())
+        reports = {r["slo"]: r for r in slo.get("slos", [])}
+        checks["slo_enabled"] = bool(slo.get("enabled"))
+        checks["slo_names"] = sorted(reports)
+        checks["all_slos_evaluated"] = (
+            set(reports) == EXPECTED_SLOS
+            and all(r["state"] in ("ok", "warn", "page")
+                    and set(r["burn"]) == {"fast", "slow"}
+                    for r in reports.values()))
+        checks["worst"] = slo.get("worst")
+        # The load above completed, so the latency SLO saw real events.
+        ev = reports.get("e2e_latency", {}).get("events", {}).get("fast", {})
+        checks["e2e_events_counted"] = (
+            ev.get("good", 0) + ev.get("bad", 0) > 0)
+
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        checks["healthz_status"] = resp.status
+        checks["healthz_ready"] = bool(health.get("ok"))
+    finally:
+        app.stop()
+
+    verdict = (checks.get("results") == args.jobs
+               and checks.get("slo_enabled")
+               and checks.get("all_slos_evaluated")
+               and checks.get("e2e_events_counted")
+               and checks.get("healthz_status") == 200
+               and checks.get("healthz_ready"))
+    report = {"metric": "slo_smoke", "ok": bool(verdict), **checks}
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report), flush=True)
+    return 0 if verdict else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

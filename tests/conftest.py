@@ -134,6 +134,26 @@ def _no_implicit_transfers(request):
         yield
 
 
+# ------------------------------------------------------ obs thread hygiene
+@pytest.fixture(autouse=True)
+def _no_leaked_obs_threads():
+    """ServeApp.stop() must JOIN the background sampler and flight-recorder
+    writer — a test that boots the live-health plane and leaks either
+    thread would keep sampling freed state under every later test. The
+    guard is name-based: those threads exist nowhere else."""
+    yield
+    import threading
+
+    from vilbert_multitask_tpu import obs
+
+    leaked = [t.name for t in threading.enumerate()
+              if t.name in (obs.SAMPLER_THREAD_NAME,
+                            obs.RECORDER_THREAD_NAME)]
+    assert not leaked, (
+        f"obs background threads leaked by this test: {leaked} — "
+        f"stop()/close() must join them")
+
+
 @pytest.fixture(scope="session")
 def tiny_config():
     from vilbert_multitask_tpu.config import ViLBertConfig

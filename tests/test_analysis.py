@@ -575,6 +575,115 @@ def test_sleep_in_nested_bounded_loop_is_clean():
     assert "VMT114" not in rules_hit(src)
 
 
+# ----------------------------------------------------------------- VMT115
+OBS = "vilbert_multitask_tpu/obs/fake.py"  # on the telemetry plane
+
+
+def test_unbounded_instance_buffer_on_obs_plane_triggers():
+    src = """
+    class Collector:
+        def __init__(self):
+            self.events = []
+
+        def record(self, e):
+            self.events.append(e)
+    """
+    assert "VMT115" in rules_hit(src, path=OBS)
+
+
+def test_unbounded_module_buffer_on_obs_plane_triggers():
+    src = """
+    FRAMES = []
+
+    def push(frame):
+        FRAMES.append(frame)
+    """
+    assert "VMT115" in rules_hit(src, path=OBS)
+
+
+def test_maxlen_less_deque_on_obs_plane_triggers():
+    src = """
+    from collections import deque
+
+    class Collector:
+        def __init__(self):
+            self.ring = deque()
+
+        def record(self, e):
+            self.ring.append(e)
+    """
+    assert "VMT115" in rules_hit(src, path=OBS)
+
+
+def test_bounded_deque_is_clean():
+    src = """
+    from collections import deque
+
+    class Collector:
+        def __init__(self):
+            self.ring = deque(maxlen=256)
+
+        def record(self, e):
+            self.ring.append(e)
+    """
+    assert "VMT115" not in rules_hit(src, path=OBS)
+
+
+def test_buffer_with_removal_is_clean():
+    # The span-stack shape: pushed and popped — bounded by its usage.
+    src = """
+    class Stack:
+        def __init__(self):
+            self.stack = []
+
+        def push(self, s):
+            self.stack.append(s)
+
+        def done(self):
+            self.stack.pop()
+    """
+    assert "VMT115" not in rules_hit(src, path=OBS)
+
+
+def test_len_guarded_reservoir_is_clean():
+    # The reservoir idiom: growth gated on a capacity check.
+    src = """
+    class Reservoir:
+        def __init__(self, cap):
+            self.cap = cap
+            self.samples = []
+
+        def observe(self, v):
+            if len(self.samples) < self.cap:
+                self.samples.append(v)
+    """
+    assert "VMT115" not in rules_hit(src, path=OBS)
+
+
+def test_import_time_table_building_is_clean():
+    # Module-level accretion at import is static data, not per-event growth.
+    src = """
+    ROWS = []
+    for i in range(4):
+        ROWS.append(i)
+    """
+    assert "VMT115" not in rules_hit(src, path=OBS)
+
+
+def test_unbounded_buffer_off_obs_plane_is_clean():
+    # The rule is scoped to the telemetry planes; elsewhere other rules own
+    # memory discipline.
+    src = """
+    class Collector:
+        def __init__(self):
+            self.events = []
+
+        def record(self, e):
+            self.events.append(e)
+    """
+    assert "VMT115" not in rules_hit(src)
+
+
 # ----------------------------------------------- suppressions and baseline
 def test_inline_suppression_by_id_name_and_next_line():
     base = """

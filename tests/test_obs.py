@@ -310,3 +310,217 @@ def test_chrome_trace_limit():
     doc = chrome_trace(tracer=tr, limit=3)
     xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
     assert [e["name"] for e in xs] == ["s7", "s8", "s9"]
+
+
+# ------------------------------------------------- sliding windows (SLIs)
+def test_histogram_window_percentile_ages_out():
+    """Windowed aggregation is the SLI substrate: old samples must leave
+    the window as the (injectable) clock advances — no sleeping."""
+    h = Histogram("win_ms", reservoir=64)
+    now = [1000.0]
+    h.clock = lambda: now[0]
+    for _ in range(10):
+        h.observe(100.0)           # slow burst at t=1000
+    now[0] += 30.0
+    for _ in range(10):
+        h.observe(1.0)             # fresh fast samples at t=1030
+    assert h.window_count(60.0) == 20
+    assert h.window_percentile(0.95, 60.0) == pytest.approx(100.0)
+    # the slow burst ages past the 60 s window; only fresh samples remain
+    now[0] += 45.0
+    assert h.window_count(60.0) == 10
+    assert h.window_percentile(0.95, 60.0) == pytest.approx(1.0)
+    assert h.count() == 20         # the lifetime view is untouched
+    assert h.window_sum(60.0) == pytest.approx(10.0)
+
+
+def test_timeseries_store_ring_and_window():
+    from vilbert_multitask_tpu.obs import TimeSeriesStore
+
+    ts = TimeSeriesStore(points=4)
+    for i in range(8):
+        ts.record("qps", float(i), ts=float(i))
+    # bounded ring: only the newest `points` samples survive
+    assert ts.points("qps") == [(4.0, 4.0), (5.0, 5.0),
+                                (6.0, 6.0), (7.0, 7.0)]
+    assert ts.latest("qps") == 7.0
+    ts.record_many({"a": 1.0, "b": 2.0}, ts=9.0)
+    assert ts.names() == ["a", "b", "qps"]
+    assert ts.snapshot()["a"] == [(9.0, 1.0)]
+
+
+def test_sampler_tick_derives_rates_from_counters():
+    from vilbert_multitask_tpu.obs import Sampler, TimeSeriesStore
+
+    store = TimeSeriesStore()
+    probe = {"sheds_total": 0.0, "depth": 3.0}
+    samp = Sampler(store, lambda: dict(probe), cadence_s=60.0)
+    first = samp.tick()
+    assert "sheds_per_s" not in first      # no previous sample yet
+    probe["sheds_total"] = 30.0
+    second = samp.tick()
+    assert second["sheds_per_s"] > 0.0     # delta / monotonic dt
+    assert "depth_per_s" not in second     # only *_total keys derive rates
+    assert "sheds_per_s" in store.names()
+
+
+def test_sampler_thread_lifecycle_and_probe_errors():
+    from vilbert_multitask_tpu.obs import Sampler, TimeSeriesStore
+
+    calls = []
+
+    def probe():
+        calls.append(1)
+        raise RuntimeError("flaky probe")
+
+    samp = Sampler(TimeSeriesStore(), probe, cadence_s=0.01)
+    samp.start()
+    samp.start()                            # idempotent
+    deadline = time.monotonic() + 5.0
+    while not calls and time.monotonic() < deadline:
+        time.sleep(0.01)
+    samp.stop()
+    assert calls                            # probe ran and errors were eaten
+    assert not any(t.name == "obs-sampler" for t in threading.enumerate())
+
+
+# ------------------------------------------------------------ burn rates
+def test_slo_page_requires_both_windows_and_decays():
+    """The acceptance property: states come from SLIDING windows — a burst
+    of old slow samples outside the fast window must not hold a PAGE."""
+    from vilbert_multitask_tpu.obs import SloEvaluator, latency_slo
+
+    h = Histogram("slo_fixture_ms", reservoir=256)
+    now = [5000.0]
+    h.clock = lambda: now[0]
+    ev = SloEvaluator([latency_slo("lat", h, 100.0, error_budget=0.05)],
+                      fast_window_s=60.0, slow_window_s=600.0)
+    # empty windows: burn 0, never a page
+    assert ev.states() == {"lat": "ok"}
+    # an all-bad burst saturates BOTH windows -> page
+    for _ in range(20):
+        h.observe(400.0)
+    assert ev.states() == {"lat": "page"}
+    # 2 minutes later the burst left the fast window: min(fast, slow)
+    # gates paging, so the state decays even though slow burn is still hot
+    now[0] += 120.0
+    (report,) = ev.evaluate()
+    assert report["state"] == "ok"
+    assert report["burn"]["fast"] == 0.0
+    assert report["burn"]["slow"] > 0.0
+
+
+def test_availability_slo_counts_failures_in_window():
+    from vilbert_multitask_tpu.obs import SloEvaluator, availability_slo
+
+    ok_h = Histogram("avail_ok_ms", reservoir=64)
+    fail_h = Histogram("avail_fail", reservoir=64)
+    now = [100.0]
+    ok_h.clock = fail_h.clock = lambda: now[0]
+    ev = SloEvaluator(
+        [availability_slo("avail", ok_h, fail_h, error_budget=0.02)],
+        fast_window_s=60.0, slow_window_s=600.0)
+    for _ in range(8):
+        ok_h.observe(5.0)
+    fail_h.observe(-1.0)
+    fail_h.observe(-1.0)
+    (report,) = ev.evaluate()
+    # 2 failures / 10 events = 20% error rate over a 2% budget: burn 10
+    assert report["burn"]["fast"] == pytest.approx(10.0)
+    assert report["state"] == "page"
+
+
+# --------------------------------------------------------- flight recorder
+def test_recorder_bundle_binds_trace_and_rotates(tmp_path):
+    from vilbert_multitask_tpu import obs
+
+    rec = obs.FlightRecorder(str(tmp_path), max_bundles=2,
+                             min_interval_s=0.0,
+                             sources={"timeseries": lambda: {"qps": 1},
+                                      "bad": lambda: 1 / 0})
+    tid = obs.new_trace_id()
+    with obs.trace_scope(tid), obs.span("unit.op"):
+        pass
+    assert rec.trigger("fault_injected", site="worker.intake",
+                       trace_id=tid)
+    rec.close()
+    (path,) = rec.bundles()
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["event"] == "fault_injected"
+    assert bundle["detail"]["trace_id"] == tid
+    assert tid in {s["trace_id"] for s in bundle["spans"]}
+    assert tid in bundle["trace_ids"]
+    assert bundle["timeseries"] == {"qps": 1}
+    # a broken source loses its own section only, never the bundle
+    assert "error" in bundle["bad"]
+    # rotation: oldest bundles beyond max_bundles are removed
+    rec2 = obs.FlightRecorder(str(tmp_path), max_bundles=2,
+                              min_interval_s=0.0)
+    for event in ("breaker_open", "drain", "worker_exception"):
+        assert rec2.trigger(event)
+        time.sleep(0.002)          # distinct ms -> distinct filenames
+    rec2.close()
+    assert len(rec2.bundles()) == 2
+    assert not any(t.name == "flight-recorder"
+                   for t in threading.enumerate())
+
+
+def test_recorder_min_interval_rate_limits(tmp_path):
+    from vilbert_multitask_tpu import obs
+
+    rec = obs.FlightRecorder(str(tmp_path), min_interval_s=300.0)
+    assert rec.trigger("breaker_open") is True
+    assert rec.trigger("breaker_open") is False   # inside the interval
+    assert rec.trigger("slo_page") is True        # per-event limiter
+    rec.close()
+
+
+def test_recorder_spike_fires_at_threshold(tmp_path):
+    from vilbert_multitask_tpu import obs
+
+    rec = obs.FlightRecorder(str(tmp_path), min_interval_s=0.0)
+    fired = [rec.spike("deadline_spike", threshold=3, window_s=60.0)
+             for _ in range(3)]
+    assert fired == [False, False, True]
+    # the window clears on fire: the count restarts
+    assert rec.spike("deadline_spike", threshold=3, window_s=60.0) is False
+    rec.close()
+
+
+def test_record_event_routes_to_installed_recorder(tmp_path):
+    from vilbert_multitask_tpu import obs
+
+    rec = obs.install_recorder(
+        obs.FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    try:
+        assert obs.active_recorder() is rec
+        assert obs.record_event("fault_injected", site="x") is True
+    finally:
+        obs.clear_recorder()
+    assert obs.active_recorder() is None
+    assert len(rec.bundles()) == 1
+    # with no recorder installed the plane is inert
+    assert obs.record_event("fault_injected", site="x") is False
+    assert obs.record_spike("deadline_spike") is False
+
+
+def test_recorder_disabled_mode_overhead_under_5us():
+    """Tier-1 guard (mirrors the tracer's): trigger sites live on prod
+    paths because an uninstalled recorder costs a global read + compare."""
+    from vilbert_multitask_tpu import obs
+
+    assert obs.active_recorder() is None
+    n = 10_000
+    best_event = best_spike = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            obs.record_event("breaker_open", breaker="b")
+        best_event = min(best_event, (time.perf_counter() - t0) / n)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            obs.record_spike("deadline_spike", trace_id="t")
+        best_spike = min(best_spike, (time.perf_counter() - t0) / n)
+    assert best_event < 5e-6, f"record_event costs {best_event * 1e6:.2f} us"
+    assert best_spike < 5e-6, f"record_spike costs {best_spike * 1e6:.2f} us"

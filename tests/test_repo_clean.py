@@ -54,12 +54,26 @@ def test_scan_set_covers_obs_and_vmt109_is_active():
     # The obs/ package must sit inside the configured scan set (it lives
     # under the library root, so no separate path entry is needed) and the
     # wall-clock-duration rule must be registered — otherwise the "obs code
-    # is lint-clean" guarantee silently stops meaning anything.
+    # is lint-clean" guarantee silently stops meaning anything. VMT115
+    # (unbounded-obs-buffer) is scoped to obs/serve paths: it only bites
+    # while those paths stay in the scan set, so it is asserted here too.
     cfg, root = load_config(REPO_ROOT)
     obs_dir = os.path.join(root, "vilbert_multitask_tpu", "obs")
     assert os.path.isdir(obs_dir)
     assert any(obs_dir.startswith(os.path.join(root, p)) for p in cfg.paths)
-    assert "VMT109" in {r.id for r in default_rules()}
+    assert {"VMT109", "VMT115"} <= {r.id for r in default_rules()}
+
+
+def test_debug_surface_is_wired():
+    # The live-health endpoints are load-bearing (check.sh's SLO smoke and
+    # the readiness probe poll them); a refactor that drops a route from
+    # the dispatch table must fail tier-1, not an incident. Source-level
+    # assertion: no server boot, stays jax-free and sub-second.
+    api_src = open(os.path.join(
+        REPO_ROOT, "vilbert_multitask_tpu", "serve", "http_api.py")).read()
+    for route in ("/healthz", "/metrics", "/debug/slo", "/debug/timeseries",
+                  "/debug/trace"):
+        assert f'"{route}"' in api_src, f"route {route} left the http api"
 
 
 def test_baseline_entries_carry_justification():

@@ -4,6 +4,7 @@ submit→enqueue→infer→persist→push path end-to-end with a tiny real engin
 
 import json
 import http.client
+import time
 import os
 import queue as queue_mod
 
@@ -881,3 +882,165 @@ def test_debug_profile_endpoints(stack, tmp_path, monkeypatch):
     finally:
         api.stop()
     assert calls == [("start", log_dir), ("stop",)]
+
+
+# --------------------------------------------------------- live SLO plane
+def _fake_clock_slos(target_ms=100.0):
+    """An evaluator over a fake-clock histogram: tests age samples by
+    advancing `now`, never by sleeping."""
+    from vilbert_multitask_tpu import obs
+
+    h = obs.Histogram("slo_endpoint_fixture_ms", reservoir=256)
+    now = [10_000.0]
+    h.clock = lambda: now[0]
+    ev = obs.SloEvaluator(
+        [obs.latency_slo("e2e_latency", h, target_ms, error_budget=0.05)],
+        fast_window_s=60.0, slow_window_s=600.0)
+    return h, now, ev
+
+
+def test_debug_slo_states_ride_sliding_windows(stack):
+    """Acceptance: /debug/slo burn states come from SLIDING windows — a
+    burst of old slow samples outside the window must not hold a PAGE."""
+    s, hub, q, store, worker = stack
+    h, now, ev = _fake_clock_slos()
+    api = ApiServer(q, store, hub, s, slos=ev)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        for _ in range(30):
+            h.observe(400.0)            # all-bad burst right now
+        conn.request("GET", "/debug/slo")
+        paged = json.loads(conn.getresponse().read())
+        assert paged["enabled"] is True
+        assert paged["worst"] == "page"
+        (rep,) = paged["slos"]
+        assert rep["slo"] == "e2e_latency" and rep["state"] == "page"
+        assert rep["burn"]["fast"] >= 4.0 and rep["burn"]["slow"] >= 4.0
+        # the same burst, aged past both windows: PAGE must not stick
+        now[0] += 1200.0
+        conn.request("GET", "/debug/slo")
+        decayed = json.loads(conn.getresponse().read())
+        assert decayed["worst"] == "ok"
+        (rep2,) = decayed["slos"]
+        assert rep2["state"] == "ok"
+        assert rep2["burn"] == {"fast": 0.0, "slow": 0.0}
+    finally:
+        api.stop()
+
+
+def test_healthz_readiness_gates_on_boot_phase_and_slo_page(stack):
+    """/healthz is a real readiness probe now: 503 while booting, 503
+    while any SLO pages, 200 once both clear — with the evidence in the
+    body for the operator who got paged."""
+    s, hub, q, store, worker = stack
+    h, now, ev = _fake_clock_slos()
+    boot = {"phase": "booting"}
+    api = ApiServer(q, store, hub, s, boot_info=boot, slos=ev)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 503
+        assert body["ok"] is False and body["reason"] == "booting"
+        assert "queue" in body and "breakers" in body
+
+        boot["phase"] = "ready"
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200 and body["ok"] is True
+
+        for _ in range(30):
+            h.observe(400.0)            # page the latency SLO
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 503
+        assert body["reason"] == "slo_page:e2e_latency"
+        assert body["slo"] == {"e2e_latency": "page"}
+
+        now[0] += 1200.0                # the incident ages out
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200
+    finally:
+        api.stop()
+
+
+def test_debug_timeseries_serves_sampled_window(stack):
+    from vilbert_multitask_tpu import obs
+
+    s, hub, q, store, worker = stack
+    ts = obs.TimeSeriesStore(points=16)
+    ts.record("queue_pending", 3.0)
+    ts.record("worker_inflight", 1.0)
+    api = ApiServer(q, store, hub, s, timeseries=ts)
+    port = api.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/debug/timeseries")
+        body = json.loads(conn.getresponse().read())
+        assert body["enabled"] is True
+        assert set(body["series"]) == {"queue_pending", "worker_inflight"}
+        ((_, v),) = body["series"]["queue_pending"]
+        assert v == 3.0
+        # windowed form parses its query parameter
+        conn.request("GET", "/debug/timeseries?window_s=60")
+        assert json.loads(conn.getresponse().read())["enabled"] is True
+    finally:
+        api.stop()
+
+
+def test_serveapp_start_exposes_build_info_uptime_and_recorder(
+        tiny_framework_cfg, features_dir, tmp_path):
+    """ServeApp.start() must publish vmt_build_info + vmt_uptime_seconds,
+    flip /healthz to ready, install the flight recorder, and stop() must
+    tear all of it down (the conftest thread guard enforces the joins)."""
+    import dataclasses
+
+    from vilbert_multitask_tpu import obs
+    from vilbert_multitask_tpu.serve.app import ServeApp
+
+    cfg = dataclasses.replace(
+        tiny_framework_cfg,
+        serving=dataclasses.replace(
+            tiny_framework_cfg.serving,
+            queue_db_path=str(tmp_path / "q.sqlite3"),
+            results_db_path=str(tmp_path / "r.sqlite3"),
+            media_root=str(tmp_path / "media"),
+            ws_port=0, sampler_cadence_s=0.05,
+        ))
+    app = ServeApp(cfg, feature_root=features_dir)
+    assert app.boot_info["phase"] == "booting"
+    app.start(worker=False)
+    try:
+        assert obs.active_recorder() is app.recorder
+        conn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                          timeout=5)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        assert resp.status == 200 and health["boot"]["phase"] == "ready"
+        assert health["boot"]["config_fingerprint"] == app.fingerprint
+
+        conn.request("GET", "/metrics?format=prometheus")
+        text = conn.getresponse().read().decode()
+        (info_line,) = [ln for ln in text.splitlines()
+                        if ln.startswith("vmt_build_info{")]
+        assert f'config_fingerprint="{app.fingerprint}"' in info_line
+        assert 'backend="cpu"' in info_line
+        assert float(info_line.rsplit(" ", 1)[1]) == 1.0
+        assert any(ln.startswith("vmt_uptime_seconds ")
+                   for ln in text.splitlines())
+
+        # the background sampler feeds the time-series store
+        deadline = time.monotonic() + 10.0
+        while not app.timeseries.names() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "queue_pending" in app.timeseries.names()
+        assert "slo_worst" in app.timeseries.names()
+    finally:
+        app.stop()
+    assert obs.active_recorder() is None

@@ -1100,11 +1100,173 @@ class NakedRetryLoop(Rule):
                         "full jitter, process retry budget)")
 
 
+# --------------------------------------------------------------------- 115
+# The always-on telemetry planes: modules under these path segments run
+# for the life of the serving process, so a buffer that only ever grows
+# there is a slow memory leak with a pager attached.
+_OBS_PLANE_RE = re.compile(r"(^|[\\/])(obs|serve)[\\/]")
+_BUFFER_GROWERS = {"append", "appendleft", "extend", "extendleft", "insert"}
+_BUFFER_REMOVERS = {"pop", "popleft", "popitem", "remove", "clear"}
+
+
+class UnboundedObsBuffer(Rule):
+    """A telemetry buffer on the obs/serve planes that only ever grows.
+
+    Every long-lived collector in this repo is bounded by construction —
+    histogram reservoirs and trace rings are ``deque(maxlen=...)``, the
+    time-series store is a ring, the flight recorder rotates its bundles.
+    A module-level or instance list (or a deque built WITHOUT ``maxlen``)
+    that functions append to, with no removal/truncation anywhere in the
+    module, breaks that contract: it grows for the life of the serving
+    process. Growth guarded by a ``len(...)`` check (the reservoir idiom)
+    or paired with any ``pop``/``clear``/slice-truncation is bounded and
+    stays clean.
+    """
+
+    id = "VMT115"
+    name = "unbounded-obs-buffer"
+    severity = "error"
+    description = ("append to a module-level/instance list or maxlen-less "
+                   "deque on the obs/serve planes with no removal or "
+                   "truncation in the module — the buffer grows for the "
+                   "process lifetime; use deque(maxlen=...) or trim it")
+
+    def _is_unbounded_ctor(self, ctx: ModuleContext,
+                           value: ast.AST) -> bool:
+        """Empty list / list() / deque(...) without a bound."""
+        if isinstance(value, ast.List) and not value.elts:
+            return True
+        if not isinstance(value, ast.Call):
+            return False
+        resolved = ctx.resolve(value.func)
+        if resolved == "list" and not value.args:
+            return True
+        if resolved.endswith("deque"):
+            # deque(iterable, maxlen) — a second positional IS the bound.
+            if len(value.args) >= 2:
+                return False
+            return not any(k.arg == "maxlen" for k in value.keywords)
+        return False
+
+    def _candidates(self, ctx: ModuleContext
+                    ) -> Tuple[Dict[str, ast.AST], Dict[str, ast.AST]]:
+        """Unbounded buffer initializers: module-level names and
+        ``self.<attr>`` assignments (attr keyed by name module-wide)."""
+        names: Dict[str, ast.AST] = {}
+        attrs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not self._is_unbounded_ctor(ctx, value):
+                continue
+            for t in targets:
+                if (isinstance(t, ast.Name)
+                        and ctx.enclosing_function(node) is None):
+                    names[t.id] = node
+                elif (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attrs[t.attr] = node
+        return names, attrs
+
+    @staticmethod
+    def _base(expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """Classify a buffer expression: ("name", x) or ("attr", x)."""
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        if isinstance(expr, ast.Attribute):
+            return ("attr", expr.attr)
+        return None
+
+    def _removals(self, ctx: ModuleContext) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        for node in ast.walk(ctx.tree):
+            # x.pop()/x.clear()/... and del x[...] both shrink the buffer.
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BUFFER_REMOVERS):
+                key = self._base(node.func.value)
+                if key:
+                    out.add(key)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        key = self._base(t.value)
+                        if key:
+                            out.add(key)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    # x[:] = ... overwrites in place; x = x[-n:] truncates.
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.slice, ast.Slice)):
+                        key = self._base(t.value)
+                        if key:
+                            out.add(key)
+                if (isinstance(node.value, ast.Subscript)
+                        and isinstance(node.value.slice, ast.Slice)):
+                    key = self._base(node.value.value)
+                    if key:
+                        out.add(key)
+        return out
+
+    def _len_guarded(self, ctx: ModuleContext, call: ast.Call,
+                     buf_text: str) -> bool:
+        """Growth under ``if len(<buf>) < cap:`` is the reservoir idiom."""
+        for anc in ctx.ancestors(call):
+            if not isinstance(anc, (ast.If, ast.While)):
+                continue
+            for n in ast.walk(anc.test):
+                if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                        and n.func.id == "len" and n.args
+                        and ast.unparse(n.args[0]) == buf_text):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _OBS_PLANE_RE.search(ctx.rel_path):
+            return
+        names, attrs = self._candidates(ctx)
+        if not names and not attrs:
+            return
+        removed = self._removals(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BUFFER_GROWERS):
+                continue
+            key = self._base(node.func.value)
+            if key is None or key in removed:
+                continue
+            kind, name = key
+            if kind == "name":
+                # Import-time table building is static data, not a leak;
+                # only growth from inside a function accretes per event.
+                if (name not in names
+                        or ctx.enclosing_function(node) is None):
+                    continue
+            elif name not in attrs:
+                continue
+            if self._len_guarded(ctx, node, ast.unparse(node.func.value)):
+                continue
+            where = ("module-level list" if kind == "name"
+                     else f"instance buffer `self.{name}`")
+            yield self.finding(
+                ctx, node, f"`.{node.func.attr}` grows {where} `{name}` "
+                f"on the obs/serve plane with no removal or truncation "
+                f"anywhere in the module — it accretes for the process "
+                f"lifetime; bound it (deque(maxlen=...), rotation, or an "
+                f"explicit trim)")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
          LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
-         PerRowTransferInLoop, NakedRetryLoop]
+         PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

@@ -434,6 +434,36 @@ class ServingConfig:
     # Graceful drain: how long stop() waits for the worker to finish
     # in-flight jobs before releasing them back to the queue.
     drain_grace_s: float = 10.0
+    # --- obs/ live-health knobs (see ARCHITECTURE.md "SLOs & flight
+    # recorder") ---
+    # Background sampler: snapshot cadence and ring length of the
+    # in-process time-series store (points per series; at a 1 s cadence
+    # 512 points ≈ the last 8.5 minutes).
+    sampler_cadence_s: float = 1.0
+    timeseries_points: int = 512
+    # Multi-window burn-rate evaluation: PAGE/WARN need the burn over the
+    # threshold on BOTH windows (fast = "happening now", slow =
+    # "sustained").
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
+    slo_warn_burn: float = 1.0
+    slo_page_burn: float = 4.0
+    # SLO targets: e2e latency p-objective, availability, and the
+    # deadline-slack floor ROADMAP item 1 asks evidence for. Budgets are
+    # the allowed bad-event ratio per objective.
+    slo_e2e_target_ms: float = 2000.0
+    slo_e2e_budget: float = 0.05
+    slo_availability_budget: float = 0.02
+    slo_slack_floor_ms: float = 1000.0
+    slo_slack_budget: float = 0.05
+    # Flight recorder: bundle directory (under serve_state by default so
+    # a soak tmpdir sweeps it), rotation/size caps, spans per bundle, and
+    # the per-event re-trigger floor.
+    recorder_dir: str = "serve_state/postmortem"
+    recorder_max_bundles: int = 16
+    recorder_max_bytes: int = 1_000_000
+    recorder_spans: int = 256
+    recorder_min_interval_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -442,6 +472,17 @@ class FrameworkConfig:
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+
+
+def config_fingerprint(cfg: FrameworkConfig) -> str:
+    """Short stable hash of the full config tree — the "which exact
+    configuration was this process running" field for `vmt_build_info`
+    and flight-recorder bundles. Same config → same fingerprint across
+    processes (sorted-key JSON over the dataclass dict)."""
+    import hashlib
+
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
 def add_backend_args(parser) -> None:

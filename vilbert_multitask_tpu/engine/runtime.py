@@ -832,6 +832,27 @@ class InferenceEngine:
                     "hits": self._input_cache_hits,
                     "misses": self._input_cache_misses}
 
+    def live_stats(self) -> Dict[str, float]:
+        """Point-in-time engine internals for the obs sampler: slab/cache
+        occupancy, compiled-program count, dispatch-breaker state (the
+        knobs an operator watches during a soak). Cheap — two lock holds,
+        no device work."""
+        cache_slots = self.cfg.engine.device_input_cache_entries
+        with self._input_cache_lock:
+            # Before the slab is lazily built every cache slot is free.
+            free = (len(self._slab_free) if self._slab is not None
+                    else cache_slots)
+            stats = {
+                "engine_cache_entries": float(len(self._input_cache)),
+                "engine_slab_slots_used": float(cache_slots - free),
+                "engine_slab_slots_total": float(cache_slots),
+            }
+        with self._compile_lock:
+            stats["engine_compiled_programs"] = float(len(self._compiled))
+        stats["engine_breaker_open"] = float(
+            self._breaker.state != "closed")
+        return stats
+
     def _pack_rows(self, rows: Sequence[Tuple[dict, Optional[str]]],
                    bucket: int) -> Tuple[dict, np.ndarray]:
         """Resolve each (host_row, cache_key) to a slab slot and return

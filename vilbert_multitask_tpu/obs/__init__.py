@@ -45,6 +45,30 @@ from vilbert_multitask_tpu.obs.export import (
     start_profile,
     stop_profile,
 )
+from vilbert_multitask_tpu.obs.timeseries import (
+    SAMPLER_THREAD_NAME,
+    Sampler,
+    TimeSeriesStore,
+)
+from vilbert_multitask_tpu.obs.recorder import (
+    RECORDER_THREAD_NAME,
+    FlightRecorder,
+    active_recorder,
+    clear_recorder,
+    install_recorder,
+    record_event,
+    record_spike,
+)
+from vilbert_multitask_tpu.obs.slo import (
+    STATE_OK,
+    STATE_PAGE,
+    STATE_WARN,
+    Slo,
+    SloEvaluator,
+    availability_slo,
+    latency_slo,
+    slack_floor_slo,
+)
 
 __all__ = [
     "Span", "Tracer", "current_trace_id", "default_tracer", "new_trace_id",
@@ -54,6 +78,11 @@ __all__ = [
     "PROMETHEUS_CONTENT_TYPE", "chrome_trace", "dump_trace",
     "render_prometheus", "start_profile", "stop_profile",
     "SHED_COUNTER", "RETRY_COUNTER", "BREAKER_GAUGE", "DEADLINE_SLACK",
+    "SAMPLER_THREAD_NAME", "Sampler", "TimeSeriesStore",
+    "RECORDER_THREAD_NAME", "FlightRecorder", "active_recorder",
+    "clear_recorder", "install_recorder", "record_event", "record_spike",
+    "STATE_OK", "STATE_PAGE", "STATE_WARN", "Slo", "SloEvaluator",
+    "availability_slo", "latency_slo", "slack_floor_slo",
 ]
 
 SPAN_HISTOGRAM = REGISTRY.histogram(

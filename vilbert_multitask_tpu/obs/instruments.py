@@ -19,6 +19,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -124,15 +125,19 @@ class Gauge(_Instrument):
 
 
 class _HistSeries:
-    """One label set's state: bucket counts + count/sum + raw reservoir."""
+    """One label set's state: bucket counts + count/sum + raw reservoir +
+    a timestamped window ring for sliding-window aggregation."""
 
-    __slots__ = ("counts", "count", "sum", "reservoir")
+    __slots__ = ("counts", "count", "sum", "reservoir", "window")
 
     def __init__(self, n_buckets: int, reservoir: int):
         self.counts = [0] * (n_buckets + 1)  # +1: the implicit +Inf bucket
         self.count = 0
         self.sum = 0.0
         self.reservoir: deque = deque(maxlen=reservoir)
+        # (t, value) pairs, same bound as the reservoir: the window is a
+        # VIEW of recent samples, never an unbounded log.
+        self.window: deque = deque(maxlen=reservoir)
 
 
 class Histogram(_Instrument):
@@ -152,6 +157,9 @@ class Histogram(_Instrument):
         self.buckets = tuple(sorted(buckets)) if buckets else log_buckets()
         self._reservoir = reservoir
         self._series: Dict[Tuple[str, ...], _HistSeries] = {}
+        # Monotonic by default; injectable so tests can age samples out of
+        # the sliding window without sleeping through it.
+        self.clock = time.perf_counter
 
     def _get_series(self, key: Tuple[str, ...]) -> _HistSeries:
         series = self._series.get(key)
@@ -164,12 +172,14 @@ class Histogram(_Instrument):
         key = self._key(labels)
         value = float(value)
         i = bisect.bisect_left(self.buckets, value)
+        now = self.clock()
         with self._lock:
             series = self._get_series(key)
             series.counts[i] += 1
             series.count += 1
             series.sum += value
             series.reservoir.append(value)
+            series.window.append((now, value))
 
     # ----------------------------------------------------------- inspection
     def samples(self, **labels) -> List[float]:
@@ -195,6 +205,43 @@ class Histogram(_Instrument):
         with self._lock:
             series = self._series.get(self._key(labels))
             return series.count if series else 0
+
+    # ------------------------------------------------------ sliding window
+    def _window_values(self, window_s: float,
+                       labels: Dict[str, object]) -> List[float]:
+        """Samples observed in the last ``window_s`` seconds. Merged
+        across label sets when none are given on a labeled histogram
+        (matching :meth:`percentile`). Filtering, never pruning: the same
+        ring answers queries for DIFFERENT windows (the burn-rate fast and
+        slow panes), so a short-window read must not evict samples a
+        longer window still needs — the deque's maxlen is the only
+        eviction."""
+        cutoff = self.clock() - window_s
+        with self._lock:
+            if not labels and self.labelnames:
+                rings = list(self._series.values())
+            else:
+                series = self._series.get(self._key(labels))
+                rings = [series] if series else []
+            return [v for s in rings for t, v in s.window if t >= cutoff]
+
+    def window_samples(self, window_s: float, **labels) -> List[float]:
+        """Raw samples inside the sliding window (bounded by the
+        reservoir size — a window longer than the ring retains covers at
+        most the newest ``reservoir`` samples)."""
+        return self._window_values(window_s, labels)
+
+    def window_count(self, window_s: float, **labels) -> int:
+        return len(self._window_values(window_s, labels))
+
+    def window_sum(self, window_s: float, **labels) -> float:
+        return sum(self._window_values(window_s, labels))
+
+    def window_percentile(self, p: float, window_s: float,
+                          **labels) -> Optional[float]:
+        """Exact percentile over the sliding window only — the live-p95
+        answer the lifetime-cumulative reservoir cannot give."""
+        return percentile(self._window_values(window_s, labels), p)
 
     def series_counts(self) -> Dict[Tuple[str, ...], int]:
         """Observation count per label set (per-task request counts)."""

@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Sequence
 
+from vilbert_multitask_tpu import obs
+
 
 class FaultInjected(ConnectionError):
     """An error injected by an active :class:`FaultPlan`.
@@ -121,6 +123,11 @@ class FaultPlan:
             return payload
         if rule.kind == "corrupt":
             return _corrupt(payload)
+        # Error-kind faults are incidents by construction: freeze the
+        # evidence (with whatever trace is live on this thread) before
+        # the exception starts unwinding through the real handling.
+        obs.record_event("fault_injected", site=site, seed=self.seed,
+                         trace_id=obs.current_trace_id())
         raise FaultInjected(
             f"injected fault at {site} (seed={self.seed})")
 

@@ -288,22 +288,33 @@ class CircuitBreaker:
                 self._set_state_locked("closed")
 
     def record_failure(self) -> None:
+        opened = ""
         with self._lock:
             now = self._clock()
             if self._state == "half_open":
                 # The probe failed: the dependency is still down.
                 self._set_state_locked("open")
                 self._opened_at = now
-                return
-            self._failures.append(now)
-            while self._failures and now - self._failures[0] > self.window_s:
-                self._failures.popleft()
-            if (self._state == "closed"
-                    and len(self._failures) >= self.failure_threshold):
-                log.warning("circuit '%s' opened: %d failures in %.1fs",
-                            self.name, len(self._failures), self.window_s)
-                self._set_state_locked("open")
-                self._opened_at = now
+                opened = "half_open_probe_failed"
+            else:
+                self._failures.append(now)
+                while (self._failures
+                       and now - self._failures[0] > self.window_s):
+                    self._failures.popleft()
+                if (self._state == "closed"
+                        and len(self._failures) >= self.failure_threshold):
+                    log.warning("circuit '%s' opened: %d failures in %.1fs",
+                                self.name, len(self._failures),
+                                self.window_s)
+                    self._set_state_locked("open")
+                    self._opened_at = now
+                    opened = "failure_threshold"
+        if opened:
+            # Flight-recorder trigger OUTSIDE the lock: the enqueue is
+            # cheap, but preflight() on other threads must never wait on
+            # it.
+            obs.record_event("breaker_open", breaker=self.name,
+                             cause=opened)
 
 
 # --------------------------------------------------------------- admission

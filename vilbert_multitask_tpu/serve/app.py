@@ -17,7 +17,7 @@ import time
 from typing import Optional
 
 from vilbert_multitask_tpu import obs
-from vilbert_multitask_tpu.config import FrameworkConfig
+from vilbert_multitask_tpu.config import FrameworkConfig, config_fingerprint
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 from vilbert_multitask_tpu.features.store import FeatureStore
 from vilbert_multitask_tpu.serve.db import ResultStore
@@ -45,7 +45,7 @@ class ServeApp:
             self.cfg = dataclasses.replace(
                 self.cfg, engine=dataclasses.replace(
                     self.cfg.engine, compilation_cache_dir=cache_dir))
-        self.boot_info: dict = {}
+        self.boot_info: dict = {"phase": "booting"}
         self.extractor = None  # set when live_extract builds a detector
         self.hub = PushHub()
         self.queue = DurableQueue(
@@ -113,20 +113,94 @@ class ServeApp:
         self.engine = engine
         self.worker = ServeWorker(self.engine, self.queue, self.store,
                                   self.hub, s)
+        # Live-health plane (obs/): the time-series store + sampler, the
+        # SLO evaluator, and the flight recorder. Built here so /debug/slo
+        # and /healthz see them from the first request; the sampler thread
+        # and the recorder's global installation happen in start().
+        self.timeseries = obs.TimeSeriesStore(points=s.timeseries_points)
+        self.slos = self._build_slos()
+        self.sampler = obs.Sampler(self.timeseries, self._sample,
+                                   cadence_s=s.sampler_cadence_s)
+        self.fingerprint = config_fingerprint(self.cfg)
+        rec_dir = s.recorder_dir
+        if rec_dir == "serve_state/postmortem":
+            # Default follows the queue db (tests and the soak point that
+            # at a tmpdir; bundles must land there too, not in CWD).
+            rec_dir = os.path.join(
+                os.path.dirname(s.queue_db_path) or "serve_state",
+                "postmortem")
+        self.recorder = obs.FlightRecorder(
+            rec_dir, max_bundles=s.recorder_max_bundles,
+            max_bytes=s.recorder_max_bytes, spans=s.recorder_spans,
+            min_interval_s=s.recorder_min_interval_s,
+            sources={
+                "timeseries": self.timeseries.snapshot,
+                "config_fingerprint": lambda: self.fingerprint,
+                "boot_info": lambda: dict(self.boot_info),
+            })
         self.api = ApiServer(
             self.queue, self.store, self.hub, s,
             metrics=self.worker.metrics, boot_info=self.boot_info,
-            stats_fn=lambda: {"input_cache": self.engine.input_cache_stats})
+            stats_fn=lambda: {"input_cache": self.engine.input_cache_stats},
+            slos=self.slos, timeseries=self.timeseries)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
         self._worker_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- live health
+    def _build_slos(self) -> "obs.SloEvaluator":
+        """The serving plane's three SLOs (targets in ServingConfig):
+        availability, e2e latency vs. target, deadline-slack floor."""
+        s = self.cfg.serving
+        m = self.worker.metrics
+        slos = [
+            obs.availability_slo(
+                "availability", m.latency, m.failure_events,
+                error_budget=s.slo_availability_budget),
+            obs.latency_slo(
+                "e2e_latency", m.latency, target_ms=s.slo_e2e_target_ms,
+                error_budget=s.slo_e2e_budget),
+            obs.slack_floor_slo(
+                "deadline_slack", obs.DEADLINE_SLACK,
+                floor_ms=s.slo_slack_floor_ms,
+                error_budget=s.slo_slack_budget),
+        ]
+        return obs.SloEvaluator(
+            slos, fast_window_s=s.slo_fast_window_s,
+            slow_window_s=s.slo_slow_window_s,
+            warn_burn=s.slo_warn_burn, page_burn=s.slo_page_burn)
+
+    def _sample(self) -> dict:
+        """One sampler tick's worth of live signals. ``*_total`` keys get
+        ``*_per_s`` rate series derived by the sampler (sheds/sec, qps)."""
+        vals: dict = {}
+        counts = self.queue.counts()
+        for state in ("pending", "inflight", "dead"):
+            vals[f"queue_{state}"] = float(counts.get(state, 0))
+        vals["worker_inflight"] = float(self.worker.inflight_count())
+        for key, v in obs.BREAKER_GAUGE.collect().items():
+            vals[f"breaker_{key[0]}"] = float(v)
+        vals["sheds_total"] = sum(obs.SHED_COUNTER.collect().values())
+        m = self.worker.metrics
+        vals["requests_total"] = float(
+            sum(m.latency.series_counts().values()))
+        vals["failures_total"] = float(m.failure_events.count())
+        vals.update(self.engine.live_stats())
+        # Burn-rate states ride the same cadence, so PAGE transitions trip
+        # the recorder even when nobody is scraping /debug/slo.
+        worst = self.slos.worst_state()
+        vals["slo_worst"] = float(
+            {"ok": 0, "warn": 1, "page": 2}.get(worst, 0))
+        return vals
 
     def warm(self) -> None:
         """Pre-compile every shape bucket (and the live detector, if
         enabled); timings land in ``/healthz``. Compile-at-request is
         debug-only everywhere in this binary — a first upload must never
         pay the detector JIT inside the worker thread."""
+        prev_phase = self.boot_info.get("phase")
+        self.boot_info["phase"] = "warming"
         t0 = time.perf_counter()
         with obs.span("serve.warmup",
                       buckets=list(self.cfg.engine.all_row_buckets())):
@@ -140,11 +214,32 @@ class ServeApp:
             pallas=self.engine.pallas_enabled,
             kernel_fallback=self.engine.kernel_fallback,
         )
+        # Warming before start() returns to "booting" (still not serving);
+        # a live re-warm must not flip an already-ready replica out of the
+        # load balancer.
+        self.boot_info["phase"] = ("ready" if prev_phase == "ready"
+                                   else "booting")
 
     def start(self, worker: bool = True) -> None:
         """Boot the tiers; ``worker=False`` serves HTTP/ws only (an external
         worker — serve/remote.py, or the chaos soak's scripted one — drains
         the queue instead)."""
+        # Fleet-inventory identity: which build/config this replica is.
+        import jax
+
+        from vilbert_multitask_tpu import __version__
+
+        obs.REGISTRY.gauge(
+            "vmt_build_info",
+            "Build/config identity labels (value is always 1).",
+            labelnames=("version", "backend", "param_dtype",
+                        "config_fingerprint"),
+        ).set(1, version=__version__, backend=jax.default_backend(),
+              param_dtype=self.cfg.engine.param_dtype,
+              config_fingerprint=self.fingerprint)
+        self.boot_info["config_fingerprint"] = self.fingerprint
+        # The flight recorder goes live before any tier can trip it.
+        obs.install_recorder(self.recorder)
         # Websocket first: /config must never advertise an unbound ws port
         # (the browser caches it and would reconnect to ws://host:0 forever).
         self.ws.start()
@@ -156,12 +251,19 @@ class ServeApp:
                 kwargs={"stop_event": self._stop},
                 daemon=True, name="serve-worker")
             self._worker_thread.start()
+        self.sampler.start()
+        self.boot_info["phase"] = "ready"
 
     def stop(self) -> None:
         """Graceful drain: signal the worker to stop CLAIMING, give it
         ``drain_grace_s`` to finish jobs in hand, then release anything
         still claimed back to pending (terminal "requeued" push, no
         delivery attempt charged) before tearing the web tiers down."""
+        # Snapshot the pre-drain state while the queues/inflight are still
+        # interesting (a SIGTERM during an incident is the bundle you want).
+        obs.record_event("drain", phase=self.boot_info.get("phase"),
+                         inflight=self.worker.inflight_count())
+        self.boot_info["phase"] = "draining"
         self._stop.set()
         if self._worker_thread:
             self._worker_thread.join(timeout=self.cfg.serving.drain_grace_s)
@@ -171,6 +273,13 @@ class ServeApp:
         self.worker.abandon_inflight()
         self.api.stop()
         self.ws.stop()
+        self.sampler.stop()
+        # Uninstall only our own recorder (another app may have replaced
+        # it); close() drains queued triggers and joins the writer thread.
+        if obs.active_recorder() is self.recorder:
+            obs.clear_recorder()
+        else:
+            self.recorder.close()
 
 
 def main(argv=None) -> None:

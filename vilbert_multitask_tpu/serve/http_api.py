@@ -48,12 +48,19 @@ class ApiServer:
         metrics=None,
         boot_info: Optional[Dict[str, Any]] = None,
         stats_fn=None,
+        slos=None,
+        timeseries=None,
     ):
         self.queue = queue
         self.store = store
         self.hub = hub
         self.serving = serving or ServingConfig()
         self.metrics = metrics
+        # Live-health wiring (ServeApp): the SLO evaluator behind
+        # /debug/slo and the 503-on-PAGE readiness rule, and the sampler's
+        # time-series store behind /debug/timeseries.
+        self.slos = slos
+        self.timeseries = timeseries
         # Live reference filled in by ServeApp as boot stages finish
         # (engine init / warmup timings, kernel path) — surfaced in /healthz.
         self.boot_info = boot_info if boot_info is not None else {}
@@ -175,6 +182,32 @@ class ApiServer:
             f.write(data)
         return path
 
+    def health(self) -> Tuple[int, Dict[str, Any]]:
+        """Readiness probe: 200 only when the process is past boot AND no
+        PAGE-severity SLO is firing — what a load balancer polls before
+        routing traffic to this replica. Body carries the evidence."""
+        phase = self.boot_info.get("phase")
+        booting = phase is not None and phase != "ready"
+        # Breaker states as names (BREAKER_GAUGE stores the code).
+        codes = {0: "closed", 1: "half_open", 2: "open"}
+        breakers = {key[0]: codes.get(int(v), str(v))
+                    for key, v in obs.BREAKER_GAUGE.collect().items()}
+        slo_states = self.slos.states() if self.slos is not None else {}
+        paging = sorted(name for name, state in slo_states.items()
+                        if state == obs.STATE_PAGE)
+        ready = not booting and not paging
+        body: Dict[str, Any] = {
+            "ok": ready,
+            "queue": self.queue.counts(),
+            "boot": self.boot_info,
+            "breakers": breakers,
+            "slo": slo_states,
+        }
+        if not ready:
+            body["reason"] = ("booting" if booting
+                              else f"slo_page:{','.join(paging)}")
+        return (200 if ready else 503), body
+
     def refresh_gauges(self) -> None:
         """Refresh point-in-time gauges on each Prometheus scrape (pull
         model: queue depth and cache occupancy are read, not pushed)."""
@@ -184,6 +217,15 @@ class ApiServer:
         counts = self.queue.counts()
         for state in ("pending", "inflight", "dead"):
             g.set(counts.get(state, 0), state=state)
+        if self.metrics is not None and hasattr(self.metrics, "uptime_s"):
+            obs.REGISTRY.gauge(
+                "vmt_uptime_seconds",
+                "Seconds since this serving process booted.",
+            ).set(round(self.metrics.uptime_s(), 1))
+        if self.slos is not None:
+            # Scrapes see current SLO state/burn gauges even when no
+            # sampler tick ran since the last change.
+            self.slos.evaluate()
         if self.stats_fn is not None:
             try:
                 stats = self.stats_fn()
@@ -284,8 +326,7 @@ class ApiServer:
                 elif path.startswith("/attention/"):
                     self._serve_attention(path)
                 elif path == "/healthz":
-                    self._json(200, {"ok": True, "queue": api.queue.counts(),
-                                     "boot": api.boot_info})
+                    self._json(*api.health())
                 elif path == "/metrics" or path.startswith("/metrics?"):
                     # NB: ``path`` retains the query string (rstrip only
                     # trims slashes), hence the startswith branch.
@@ -304,6 +345,36 @@ class ApiServer:
                         except Exception:  # noqa: BLE001 — stats best-effort
                             pass
                     self._json(200, snap)
+                elif path == "/debug/slo":
+                    if api.slos is None:
+                        self._json(200, {"enabled": False, "slos": []})
+                        return
+                    reports = api.slos.evaluate()
+                    states = [r["state"] for r in reports]
+                    worst = (obs.STATE_PAGE if obs.STATE_PAGE in states
+                             else obs.STATE_WARN if obs.STATE_WARN in states
+                             else obs.STATE_OK)
+                    self._json(200, {
+                        "enabled": True,
+                        "worst": worst,
+                        "slos": reports,
+                    })
+                elif (path == "/debug/timeseries"
+                      or path.startswith("/debug/timeseries?")):
+                    if api.timeseries is None:
+                        self._json(200, {"enabled": False, "series": {}})
+                        return
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        window = float(q.get("window_s", ["0"])[0]) or None
+                    except ValueError:
+                        window = None
+                    self._json(200, {
+                        "enabled": True,
+                        "series": api.timeseries.snapshot(window),
+                    })
                 elif path == "/debug/trace" or path.startswith("/debug/trace?"):
                     from urllib.parse import parse_qs, urlsplit
 

@@ -33,6 +33,13 @@ class Metrics:
                               "End-to-end request latency (ms).",
                               labelnames=("task",), reservoir=reservoir)
         self._failures: Counter = Counter()
+        # Failures as a (standalone) histogram too: the availability SLO
+        # needs failures COUNTED OVER A SLIDING WINDOW, which the lifetime
+        # Counter above cannot answer. Values are the task id; only
+        # window_count matters.
+        self._fail_hist = Histogram("request_failures",
+                                    "Terminal request failures.",
+                                    reservoir=reservoir)
         # Uptime is wall-clock by definition (reported across restarts,
         # compared against deploy timestamps) — not a duration measurement.
         self._started = time.time()
@@ -43,11 +50,20 @@ class Metrics:
     def record_failure(self, task_id: Optional[int] = None) -> None:
         with self._lock:
             self._failures[task_id if task_id is not None else -1] += 1
+        self._fail_hist.observe(float(task_id if task_id is not None else -1))
 
     @property
     def latency(self) -> Histogram:
         """The underlying histogram (Prometheus exposition reads buckets)."""
         return self._lat
+
+    @property
+    def failure_events(self) -> Histogram:
+        """Windowed failure events (availability-SLO bad counter)."""
+        return self._fail_hist
+
+    def uptime_s(self) -> float:
+        return time.time() - self._started  # vmtlint: disable=VMT109 — uptime is wall-clock, not a latency
 
     def snapshot(self) -> Dict[str, Any]:
         lat = sorted(self._lat.all_samples())
@@ -62,7 +78,7 @@ class Metrics:
             return round(v, 3) if v is not None else None
 
         return {
-            "uptime_s": round(time.time() - self._started, 1),  # vmtlint: disable=VMT109 — uptime is wall-clock, not a latency
+            "uptime_s": round(self.uptime_s(), 1),
             "requests": sum(by_task.values()),
             "by_task": by_task,
             "failures": {str(k): v for k, v in sorted(failures.items())},

@@ -191,6 +191,11 @@ class ServeWorker:
         with self._inflight_lock:
             self._inflight.pop(job_id, None)
 
+    def inflight_count(self) -> int:
+        """Jobs claimed but not yet finished (obs sampler probe)."""
+        with self._inflight_lock:
+            return len(self._inflight)
+
     # ------------------------------------------------------------- deadlines
     @staticmethod
     def _deadline_of(job: Job) -> Optional[Deadline]:
@@ -214,6 +219,11 @@ class ServeWorker:
         up waiting; a forward would be pure waste). Ack, not nack — the
         outcome is final, not retryable."""
         obs.SHED_COUNTER.inc(reason="deadline")
+        # One expiry is traffic; a burst is an incident. The spike tracker
+        # dumps a postmortem bundle only when expiries cluster.
+        obs.record_spike("deadline_spike",
+                         trace_id=job.body.get("trace_id"),
+                         task_id=job.body.get("task_id", ""))
         log_to_terminal(
             self.hub, job.body.get("socket_id", ""),
             {"terminal": "Deadline exceeded before the job could be "
@@ -387,6 +397,13 @@ class ServeWorker:
     def _fail_job(self, job: Job) -> str:
         """nack + telemetry; returns 'requeued' or 'dead'."""
         self.metrics_failure_for(job)
+        # Freeze the evidence while the traceback is still current — by
+        # the time a redelivery dead-letters, the interesting spans have
+        # aged out of the ring.
+        obs.record_event("worker_exception", job_id=job.id,
+                         trace_id=job.body.get("trace_id"),
+                         task_id=job.body.get("task_id", ""),
+                         error=traceback.format_exc(limit=5))
         status = self.queue.nack(job.id)
         self._untrack(job.id)
         if status == "dead":

@@ -33,6 +33,13 @@ echo "== chaos smoke (seeded FaultPlan, no-lost-jobs invariant) =="
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --chaos --jobs 15 \
   --out /tmp/CHAOS_SOAK.json || fail=1
 
+echo "== scheduler smoke (continuous batching >= solo loop, no lost jobs) =="
+# Same burst twice through one engine: serial batch=1 loop vs. the
+# continuous-batching scheduler. Gate: scheduler keeps every job (exactly
+# one result each, queue drained) and at least matches solo throughput.
+JAX_PLATFORMS=cpu python scripts/sched_smoke.py --jobs 32 \
+  --out /tmp/SCHED_SMOKE.json || fail=1
+
 echo "== SLO smoke (live-health plane answers under load) =="
 # Boot → synthetic load → /debug/slo parses with every SLO evaluated
 # (both burn windows) and /healthz reports ready.

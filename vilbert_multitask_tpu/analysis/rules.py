@@ -1262,11 +1262,118 @@ class UnboundedObsBuffer(Rule):
                 f"explicit trim)")
 
 
+# --------------------------------------------------------------------- 116
+# Calls that block the calling thread outright. sqlite3.connect covers the
+# serving plane's I/O idiom (every DB op opens a per-call connection, so
+# the connect call IS the disk touch); the jax entries pin the thread on
+# device round trips (same effects table as VMT113).
+_BLOCKING_DIRECT = {
+    "time.sleep": "sleeps the thread outright",
+    "sqlite3.connect": "performs SQLite disk I/O",
+    "jax.device_put": "uploads host bytes to the device",
+    "jax.device_get": "pulls device buffers back to the host",
+    "jax.block_until_ready": "stalls the host on device completion",
+}
+# The serving plane only: the engine's deliberate device_put under its
+# input-cache lock (slab insert) is the documented exception — serialized
+# uploads ARE its contract — so this rule scopes to serve/.
+_SCHED_PLANE_RE = re.compile(r"(^|[\\/])serve[\\/]")
+
+
+class BlockingCallUnderSchedulerLock(Rule):
+    """A blocking call reachable while a serving-plane lock is held.
+
+    The continuous-batching scheduler's condvar guards the ready list the
+    intake pool and dispatch loop share; the worker's inflight lock sits
+    on every claim/finish. A device dispatch, ``device_get``, SQLite open,
+    or ``time.sleep`` executed with such a lock held turns that one slow
+    call into a convoy: every intake thread and the dispatcher pile up on
+    the lock for the duration (the latency anatomy's execute window,
+    spent inside a mutex). Reuses VMT110's per-class lock inference —
+    calls flagged when lexically inside ``with self.<lock>:`` or in a
+    method the fixed point proves only ever runs with the lock held — and
+    VMT113's call-graph witnesses for project calls that transfer
+    transitively. ``Condition.wait`` stays clean (it releases the lock);
+    so does everything outside serve/ (the engine's slab insert
+    deliberately serializes uploads under its cache lock).
+    """
+
+    id = "VMT116"
+    name = "blocking-call-under-scheduler-lock"
+    severity = "error"
+    description = ("device dispatch, device_get, SQLite I/O, or time.sleep "
+                   "reachable while holding a serving-plane lock in a "
+                   "threaded class — the lock convoy stalls every sharer "
+                   "for the call's duration")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None or not _SCHED_PLANE_RE.search(ctx.rel_path):
+            return
+        mod = ctx.project.module(ctx)
+        if mod is None:
+            return
+        cg = ctx.project.callgraph
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = _ClassLockAnalysis(ctx, cls)
+            if not info.locks:
+                continue
+            # Single-threaded classes can't convoy — same witness bar as
+            # VMT110.
+            witness = ctx.project.thread_witness(ctx, cls)
+            if witness is None:
+                continue
+            lock = sorted(info.locks)[0]
+            for mname, method in info.methods.items():
+                if mname in _INIT_METHODS:
+                    continue
+                locked_method = mname in info.locked_only
+                held = (f"`{cls.name}.{mname}` only ever runs with "
+                        f"`self.{lock}` held" if locked_method
+                        else f"inside `with self.{lock}:`")
+                for call in ast.walk(method):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    # Nested defs escape the lock (they run later, on
+                    # whatever thread calls them).
+                    if ctx.enclosing_function(call) is not method:
+                        continue
+                    if not (locked_method
+                            or info._lexically_guarded(call)):
+                        continue
+                    resolved = ctx.resolve(call.func)
+                    if resolved in _BLOCKING_DIRECT:
+                        yield self.finding(
+                            ctx, call, f"`{resolved}` "
+                            f"{_BLOCKING_DIRECT[resolved]} while "
+                            f"{held}; `{cls.name}` runs on threads "
+                            f"({witness}) — every sharer convoys on the "
+                            f"lock for the call's duration; move the "
+                            f"blocking work outside the critical section")
+                        continue
+                    fn = cg.by_node.get(id(method))
+                    if fn is None:
+                        continue
+                    target = cg.resolve_callable(mod, call.func, fn.scope,
+                                                 fn.cls_scope)
+                    tw = ctx.project.transfer_witness(target)
+                    if tw:
+                        yield self.finding(
+                            ctx, call, f"`{target}` performs a "
+                            f"host<->device transfer ({tw}) while {held}; "
+                            f"`{cls.name}` runs on threads ({witness}) — "
+                            f"the device round trip convoys every sharer "
+                            f"on the lock; dispatch outside the critical "
+                            f"section")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
          LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
-         PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer]
+         PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer,
+         BlockingCallUnderSchedulerLock]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

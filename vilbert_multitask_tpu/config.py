@@ -434,6 +434,32 @@ class ServingConfig:
     # Graceful drain: how long stop() waits for the worker to finish
     # in-flight jobs before releasing them back to the queue.
     drain_grace_s: float = 10.0
+    # --- continuous-batching scheduler (serve/scheduler.py) ---
+    # When enabled, run_forever drains through the pipelined three-stage
+    # data plane (intake pool -> EDF window scheduler -> completion stage)
+    # instead of the synchronous step_batch loop.
+    sched_enabled: bool = True
+    # Intake pool width: threads claiming jobs and running feature I/O +
+    # prep concurrently with the device forward.
+    sched_intake_threads: int = 4
+    # Max READY (claimed + prepped, undispatched) jobs. Doubles as intake
+    # backpressure AND the admission signal: ready jobs stay 'inflight' in
+    # the durable queue, so they keep counting against the
+    # AdmissionController's pending+inflight depth at the HTTP door.
+    sched_ready_depth: int = 64
+    # Adaptive batching window bounds: the scheduler lingers up to the
+    # current window for co-arriving jobs before firing a partial batch;
+    # the window stretches (x2 up to max) after full buckets and shrinks
+    # (/2 down to min) after partial ones, so an idle system fires nearly
+    # immediately and a backlogged one packs bigger batches.
+    sched_window_min_s: float = 0.002
+    sched_window_max_s: float = 0.05
+    # A ready member whose deadline slack drops below this fires the batch
+    # immediately (EDF front of the queue must not wait out the window).
+    sched_near_deadline_ms: float = 250.0
+    # Bound on completed-but-unpersisted results queued to the completion
+    # stage (persist/push backpressure on the dispatch thread).
+    sched_completion_depth: int = 128
     # --- obs/ live-health knobs (see ARCHITECTURE.md "SLOs & flight
     # recorder") ---
     # Background sampler: snapshot cadence and ring length of the

@@ -939,6 +939,7 @@ class InferenceEngine:
     def run_many(
         self, reqs: Sequence[PreparedRequest], *,
         chunk_rows: Optional[int] = None, deadline=None,
+        on_result=None,
     ) -> List[dec.TaskResult]:
         """Cross-task micro-batching: many single-image requests, ONE forward.
 
@@ -952,6 +953,12 @@ class InferenceEngine:
         family reads its own row span (see :meth:`decode`), and
         even-image-count requests lead each chunk so NLVR2 pairs keep the
         binary head's 2k/2k+1 alignment (see :meth:`chunk_plan`).
+
+        ``on_result(pos, result)`` streams each member's decoded result as
+        its chunk drains — the continuous-batching scheduler hands results
+        to its completion stage while later chunks are still on the
+        device. Exceptions from the callback propagate (the caller owns
+        per-member error handling).
         """
         if not reqs:
             return []
@@ -992,6 +999,8 @@ class InferenceEngine:
                 for pos, r in c:
                     out[pos] = self.decode(r, bundle, row=row)
                     row += r.n_images
+                    if on_result is not None:
+                        on_result(pos, out[pos])
             dec_s += time.perf_counter() - td
 
         with obs.span("engine.run_many", n_requests=len(reqs),

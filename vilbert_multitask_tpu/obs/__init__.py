@@ -78,6 +78,7 @@ __all__ = [
     "PROMETHEUS_CONTENT_TYPE", "chrome_trace", "dump_trace",
     "render_prometheus", "start_profile", "stop_profile",
     "SHED_COUNTER", "RETRY_COUNTER", "BREAKER_GAUGE", "DEADLINE_SLACK",
+    "BATCH_FILL", "SCHED_WAIT", "QUEUE_WAIT", "BATCHES_DISPATCHED",
     "SAMPLER_THREAD_NAME", "Sampler", "TimeSeriesStore",
     "RECORDER_THREAD_NAME", "FlightRecorder", "active_recorder",
     "clear_recorder", "install_recorder", "record_event", "record_spike",
@@ -113,6 +114,30 @@ DEADLINE_SLACK = REGISTRY.histogram(
     "vmt_deadline_slack_ms",
     "Remaining deadline budget when the worker picked the job up (ms).",
     labelnames=("task",),
+)
+
+# Continuous-batching scheduler instruments (serve/scheduler.py).
+BATCH_FILL = REGISTRY.histogram(
+    "vmt_batch_fill",
+    "Dispatched-chunk occupancy as a fraction of its row bucket (1.0 = "
+    "the bucket was full; lower = padded rows burned).",
+    labelnames=("bucket",),
+    buckets=tuple(i / 16 for i in range(1, 17)),
+)
+SCHED_WAIT = REGISTRY.histogram(
+    "vmt_sched_wait_ms",
+    "Time a ready (claimed + prepped) job waited in the scheduler's "
+    "ready-queue before its batch fired (ms).",
+)
+QUEUE_WAIT = REGISTRY.histogram(
+    "vmt_queue_wait_ms",
+    "Publish-to-claim latency (ms): POST / stamp to worker claim, the "
+    "queueing delay Metrics.record's intake-anchored e2e cannot see.",
+    labelnames=("task",),
+)
+BATCHES_DISPATCHED = REGISTRY.counter(
+    "vmt_batches_dispatched_total",
+    "Device chunks dispatched by the continuous-batching scheduler.",
 )
 
 

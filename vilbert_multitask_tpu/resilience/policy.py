@@ -89,6 +89,12 @@ class Deadline:
     def expired(self) -> bool:
         return self.remaining_s() <= 0.0
 
+    def expires_at(self) -> float:
+        """Absolute expiry on this process's ``perf_counter`` timeline —
+        the EDF sort key (comparable across Deadlines in one process,
+        meaningless across processes)."""
+        return self._expires_perf
+
     def to_wire(self) -> Dict[str, float]:
         """The job-body form (rides next to ``trace_id``)."""
         return {"budget_s": self.budget_s, "issued_unix": self.issued_unix}

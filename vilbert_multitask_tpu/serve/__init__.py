@@ -12,10 +12,12 @@ from vilbert_multitask_tpu.serve.metrics import Metrics
 from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, Job, make_job_message
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
+from vilbert_multitask_tpu.serve.scheduler import ContinuousScheduler
 from vilbert_multitask_tpu.serve.worker import ServeWorker
 
 __all__ = [
     "ApiServer",
+    "ContinuousScheduler",
     "DurableQueue",
     "Job",
     "Metrics",

@@ -187,6 +187,9 @@ class ServeApp:
             sum(m.latency.series_counts().values()))
         vals["failures_total"] = float(m.failure_events.count())
         vals.update(self.engine.live_stats())
+        # Scheduler plane (empty dict while the legacy loop runs): ready
+        # depth, adaptive window, and *_total dispatch counters.
+        vals.update(self.worker.scheduler_stats())
         # Burn-rate states ride the same cadence, so PAGE transitions trip
         # the recorder even when nobody is scraping /debug/slo.
         worst = self.slos.worst_state()

@@ -26,6 +26,7 @@ import mimetypes
 import os
 import random
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -137,7 +138,8 @@ class ApiServer:
                 # the budget, so a job stuck behind a backlog expires instead
                 # of burning a forward for a long-gone client.
                 deadline=(Deadline(budget).to_wire()
-                          if budget and budget > 0 else None)))
+                          if budget and budget > 0 else None),
+                published_unix=time.time()))
         sp.set(task_id=task_id, job_id=job_id, n_images=len(images))
         return 200, {"job_id": job_id, "task": spec.name}
 

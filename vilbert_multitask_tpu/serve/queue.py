@@ -204,7 +204,8 @@ def make_job_message(image_paths, question: str, task_id: int,
                      socket_id: str, *,
                      collect_attention: "bool | str" = False,
                      trace_id: "str | None" = None,
-                     deadline: "Dict[str, float] | None" = None
+                     deadline: "Dict[str, float] | None" = None,
+                     published_unix: "float | None" = None
                      ) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
     list of absolute paths, ``question`` the (pre-lowercased) query.
@@ -233,4 +234,10 @@ def make_job_message(image_paths, question: str, task_id: int,
         # Deadline.to_wire(): the worker re-anchors the remaining budget to
         # its own monotonic clock and sheds expired jobs before dispatch.
         msg["deadline"] = deadline
+    if published_unix is not None:
+        # Wall-clock submit stamp (cross-process, so epoch not monotonic —
+        # same rationale as Deadline.issued_unix): the worker's claim path
+        # turns it into vmt_queue_wait_ms, the publish→claim delay that
+        # intake-anchored e2e latency cannot see.
+        msg["published_unix"] = published_unix
     return msg

@@ -111,6 +111,10 @@ class ServeWorker:
         # these back to the queue (no attempt charged) and tells the client.
         self._inflight_lock = threading.Lock()
         self._inflight: Dict[int, Job] = {}
+        # Set by run_forever when serving.sched_enabled — the continuous
+        # batching data plane (serve/scheduler.py) this worker drains
+        # through; None while running the legacy step_batch loop.
+        self.scheduler = None
 
     # ------------------------------------------------------------- job cycle
     def _intake(self, job: Job):
@@ -183,6 +187,17 @@ class ServeWorker:
                 "worker.claim", t0, time.perf_counter() - t0,
                 trace_id=job.body.get("trace_id"), job_id=job.id,
                 attempts=job.attempts)
+            published = job.body.get("published_unix")
+            if published is not None:
+                # Publish→claim latency. Wall-clock delta against the
+                # submitter's epoch stamp — cross-process, so monotonic
+                # clocks cannot be compared (same rationale as
+                # Deadline.issued_unix); clamped because unsynced clocks
+                # can run the difference slightly negative.
+                wait_s = time.time() - float(published)  # vmtlint: disable=VMT109
+                obs.QUEUE_WAIT.observe(
+                    max(wait_s, 0.0) * 1e3,
+                    task=str(job.body.get("task_id", "")))
             with self._inflight_lock:
                 self._inflight[job.id] = job
         return job
@@ -455,13 +470,37 @@ class ServeWorker:
                  "question": job.body.get("question", "")})
         return len(abandoned)
 
+    def scheduler_stats(self) -> Dict[str, float]:
+        """Continuous-batching scheduler state for the sampler (empty when
+        running the legacy loop)."""
+        sched = self.scheduler
+        return sched.stats() if sched is not None else {}
+
     def run_forever(self, *, poll_interval_s: float = 0.05,
                     stop_event=None, batch_jobs: Optional[int] = None) -> None:
-        """The consume loop (reference worker.py:672-673), micro-batched;
-        ``batch_jobs`` defaults to the engine's largest compiled row bucket
-        (see step_batch). ``stop_event`` doubles as the drain signal:
-        step_batch stops claiming the moment it is set, so in-hand work
-        finishes and the loop exits clean."""
+        """The consume loop (reference worker.py:672-673).
+
+        With ``serving.sched_enabled`` (the default) this drains through
+        the continuous-batching scheduler — pipelined intake, adaptive
+        EDF window dispatch, async completion (serve/scheduler.py).
+        Otherwise the legacy synchronous step_batch loop; ``batch_jobs``
+        applies only there (defaults to the engine's largest compiled row
+        bucket). ``stop_event`` is the drain signal either way: claiming
+        stops the moment it is set, in-hand work finishes, and the loop
+        exits clean."""
+        if self.serving.sched_enabled:
+            from vilbert_multitask_tpu.serve.scheduler import (
+                ContinuousScheduler,
+            )
+
+            self.scheduler = ContinuousScheduler(
+                self, stop_event=stop_event,
+                poll_interval_s=poll_interval_s)
+            try:
+                self.scheduler.run()
+            finally:
+                self.scheduler = None
+            return
         while stop_event is None or not stop_event.is_set():
             if self.step_batch(batch_jobs, stop_event=stop_event) == 0:
                 time.sleep(poll_interval_s)

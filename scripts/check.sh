@@ -40,6 +40,14 @@ echo "== scheduler smoke (continuous batching >= solo loop, no lost jobs) =="
 JAX_PLATFORMS=cpu python scripts/sched_smoke.py --jobs 32 \
   --out /tmp/SCHED_SMOKE.json || fail=1
 
+echo "== failover smoke (replica pool: seeded kill, exactly-one-terminal) =="
+# 2-replica dryrun pool soak with a seeded mid-burst replica kill: >=1.5x
+# qps vs 1 replica, rolling swap loses zero requests, the killed replica's
+# batch fails over (release, no attempt charged) with exactly one terminal
+# per job, and the corpse shows dead in /healthz within a sampler cadence.
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --replicas 2 --dryrun \
+  --kill-replica --seed 7 --jobs 40 --out /tmp/POOL_SOAK.json || fail=1
+
 echo "== SLO smoke (live-health plane answers under load) =="
 # Boot → synthetic load → /debug/slo parses with every SLO evaluated
 # (both burn windows) and /healthz reports ready.

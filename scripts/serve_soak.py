@@ -19,8 +19,19 @@ host-side; the forward is not the subject here) and prints ONE JSON line
 plus an artifact file. ``--full`` uses the serving-size model — on a TPU
 window that makes this the full-system hardware soak.
 
+``--replicas N --dryrun`` runs the REPLICA-POOL soak: N stub engines whose
+per-row service time is a GIL-releasing sleep (so replica concurrency shows
+on a 1-core box) behind the real pool/scheduler/queue planes. It always
+runs a 1-replica baseline burst first and reports the pool/baseline qps
+ratio, plus a rolling checkpoint swap mid-burst (zero requests lost, >=1
+replica ready throughout). ``--kill-replica`` adds a seeded chaos burst:
+one replica is silently killed mid-burst and the run asserts exactly one
+terminal per job, zero double-executions, and the dead replica visible in
+/healthz within about one sampler cadence. Artifact: SERVE_SOAK_POOL.json.
+
 Usage: python scripts/serve_soak.py [--jobs 96] [--out SERVE_SOAK.json]
        [--full] [--chaos] [--seed 0]
+       [--replicas 2 --dryrun [--kill-replica]]
 """
 
 from __future__ import annotations
@@ -142,6 +153,322 @@ def _chaos_worker(app, retry_budget_hint: float = 1e6):
                        RemoteHub(client), app.cfg.serving)
 
 
+# ----------------------------------------------------- replica-pool soak
+class _DryPrepared:
+    """The prepared-request surface the scheduler/worker touch: task spec,
+    row count, and (for grounding only, unused here) source images."""
+
+    __slots__ = ("spec", "n_images", "images", "question")
+
+    def __init__(self, spec, n_images, question):
+        self.spec = spec
+        self.n_images = n_images
+        self.images = []
+        self.question = question
+
+
+class _DryResult:
+    kind = "vqa"
+
+    def __init__(self, question):
+        self.question = question
+
+    def to_json(self):
+        return {"answers": [{"answer": "dry", "confidence": 1.0}]}
+
+
+class DryrunEngine:
+    """A stub replica whose per-row service time is a GIL-releasing sleep.
+
+    The pool soak's subject is the SERVING planes — pool routing, the
+    scheduler's per-replica executor, failover, the swap drain — not the
+    forward. A sleep models a device wait accurately for that purpose: it
+    releases the GIL, so two replicas genuinely overlap on a 1-core box
+    and the >=1.5x scaling criterion measures the dispatch plane, not
+    XLA's thread pool.
+    """
+
+    def __init__(self, cfg, name: str, service_ms_per_row: float = 12.0):
+        from vilbert_multitask_tpu.config import TASK_REGISTRY
+
+        self._registry = TASK_REGISTRY
+        self.cfg = cfg
+        self.replica_id = name
+        self.killed = False
+        self.mesh = None
+        self.pallas_enabled = False
+        self.kernel_fallback = False
+        self.stage_times = {}
+        self.input_cache_stats = {}
+        self.service_s = service_ms_per_row / 1e3
+        self.jobs_served = 0
+        self.batches = 0
+        self.loads = 0
+        self._lock = threading.Lock()
+
+    def warmup(self, buckets=None, parallel=None):
+        pass
+
+    def prepare_from_store(self, task_id, question, image_paths):
+        return _DryPrepared(self._registry[int(task_id)],
+                            max(len(image_paths), 1), question)
+
+    def chunk_plan(self, n_images):
+        max_rows = self.cfg.engine.max_batch_rows()
+        chunks, cur, rows = [], [], 0
+        for i, n in enumerate(n_images):
+            if cur and rows + n > max_rows:
+                chunks.append(cur)
+                cur, rows = [], 0
+            cur.append(i)
+            rows += n
+        if cur:
+            chunks.append(cur)
+        return chunks
+
+    def _gate(self):
+        if self.killed:
+            from vilbert_multitask_tpu.resilience import ReplicaKilled
+
+            raise ReplicaKilled(
+                f"replica {self.replica_id} killed (chaos)")
+
+    def run(self, req, **kwargs):
+        self._gate()
+        time.sleep(self.service_s * req.n_images)
+        self._gate()
+        with self._lock:
+            self.jobs_served += 1
+        return None, _DryResult(req.question)
+
+    def run_many(self, reqs, on_result=None, **kwargs):
+        self._gate()
+        time.sleep(self.service_s * sum(r.n_images for r in reqs))
+        # Second gate AFTER the service wait: a kill landing mid-batch
+        # fails the whole batch before any member streams — the failover
+        # path the chaos burst exists to exercise.
+        self._gate()
+        results = [_DryResult(r.question) for r in reqs]
+        with self._lock:
+            self.jobs_served += len(reqs)
+            self.batches += 1
+        if on_result is not None:
+            for i, res in enumerate(results):
+                on_result(i, res)
+        return results
+
+    def live_stats(self):
+        return {"dry_jobs_served": float(self.jobs_served)}
+
+    def load_params(self, params):
+        with self._lock:
+            self.loads += 1
+
+
+def _pool_burst(jobs: int, replicas: int, *, seed: int = 0,
+                kill: bool = False, swap: bool = False,
+                service_ms: float = 12.0, label: str = "") -> dict:
+    """One burst against a fresh app over ``replicas`` dryrun engines.
+
+    Returns the burst report; ``kill``/``swap`` inject their chaos once
+    the terminal count crosses a threshold, so the event always lands
+    mid-burst with traffic in flight.
+    """
+    import random
+
+    from vilbert_multitask_tpu.serve.app import ServeApp
+
+    root = tempfile.mkdtemp(prefix="serve_soak_pool_")
+    cfg = _build_cfg(root, False)
+    engines = [DryrunEngine(cfg, f"r{i}", service_ms_per_row=service_ms)
+               for i in range(replicas)]
+    app = ServeApp(cfg, engine=engines)
+    app.start()
+    pool = app.engine
+    sock = f"pool-{label}"
+    sub = app.hub.subscribe(sock)
+    terminals: dict = {}
+    dup_terminals: list = []
+    done = threading.Event()
+
+    def consume():
+        try:
+            while len(terminals) < jobs:
+                frame = sub.get(timeout=90)
+                if "result" in frame:
+                    q = frame["result"]["question"]
+                elif (frame.get("dead_letter")
+                      or frame.get("deadline_exceeded")
+                      or "error" in frame):
+                    q = frame.get("question", "")
+                else:
+                    continue  # progress / requeued notices are not terminal
+                if q in terminals:
+                    dup_terminals.append(q)
+                else:
+                    terminals[q] = time.perf_counter()
+        except queue_mod.Empty:
+            pass
+        finally:
+            done.set()
+
+    reader = threading.Thread(target=consume, daemon=True)
+    reader.start()
+
+    conn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                      timeout=30)
+    t_burst = time.perf_counter()
+    for i in range(jobs):
+        task_id, q_t, n_img = PATTERN[i % len(PATTERN)]
+        body = json.dumps({
+            "task_id": task_id, "socket_id": sock,
+            "question": q_t.format(i=i),
+            "image_list": [f"img_{k}.jpg" for k in range(n_img)],
+        })
+        conn.request("POST", "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        resp.read()
+
+    def _wait_terminals(n):
+        while len(terminals) < n and not done.is_set():
+            time.sleep(0.01)
+
+    swap_report = None
+    if swap:
+        _wait_terminals(max(1, jobs // 4))
+        swap_report = app.rolling_swap(params={"soak": "v2"})
+
+    kill_info = None
+    if kill:
+        victim = random.Random(seed).choice(
+            [r.name for r in pool.replicas])
+        _wait_terminals(max(1, jobs // 2))
+        t_kill = time.perf_counter()
+        pool.kill(victim)
+        dead_visible_s = None
+        hconn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                           timeout=10)
+        while time.perf_counter() - t_kill < 10.0:
+            hconn.request("GET", "/healthz")
+            payload = json.loads(hconn.getresponse().read())
+            states = {r["name"]: r["state"]
+                      for r in payload.get("replicas", [])}
+            if states.get(victim) == "dead":
+                dead_visible_s = round(time.perf_counter() - t_kill, 3)
+                break
+            time.sleep(0.01)
+        hconn.close()
+        kill_info = {"victim": victim, "seed": seed,
+                     "dead_visible_s": dead_visible_s,
+                     "sampler_cadence_s":
+                         cfg.serving.sampler_cadence_s}
+
+    all_done = done.wait(timeout=180)
+    makespan_s = ((max(terminals.values()) - t_burst)
+                  if terminals else time.perf_counter() - t_burst)
+    app.stop()
+    qps = round(len(terminals) / makespan_s, 2) if makespan_s > 0 else 0.0
+    report = {
+        "label": label,
+        "replicas": replicas,
+        "jobs": jobs,
+        "completed": len(terminals),
+        "all_completed": bool(all_done and len(terminals) == jobs),
+        "duplicate_terminals": dup_terminals,
+        "qps": qps,
+        "makespan_s": round(makespan_s, 2),
+        "service_ms_per_row": service_ms,
+        "failovers_total": sum(r.failovers for r in pool.replicas),
+        "per_replica": {
+            r.name: {
+                "state": r.state,
+                "jobs_served": r.engine.jobs_served,
+                "qps": (round(r.engine.jobs_served / makespan_s, 2)
+                        if makespan_s > 0 else 0.0),
+                "batches": r.engine.batches,
+                "failovers": r.failovers,
+                "param_loads": r.engine.loads,
+            } for r in pool.replicas
+        },
+    }
+    if swap_report is not None:
+        report["swap"] = {
+            "replicas_swapped":
+                [r["name"] for r in swap_report["replicas"]],
+            "min_ready_seen": swap_report["min_ready_seen"],
+            "total_s": swap_report["total_s"],
+            # Zero-downtime verdict: every submitted job still reached a
+            # terminal state despite the mid-burst drain/load/ready walk.
+            "requests_lost": jobs - len(terminals),
+        }
+    if kill_info is not None:
+        report["kill"] = kill_info
+    return report
+
+
+def run_pool_soak(args) -> int:
+    """The replica-pool soak: baseline burst, scaled burst with a rolling
+    swap mid-burst, and (``--kill-replica``) a seeded chaos burst."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    baseline = _pool_burst(args.jobs, 1, seed=args.seed,
+                           label="baseline-1x")
+    pool_run = _pool_burst(args.jobs, args.replicas, seed=args.seed,
+                           swap=True, label=f"pool-{args.replicas}x")
+    ratio = (round(pool_run["qps"] / baseline["qps"], 2)
+             if baseline["qps"] else None)
+    checks = {
+        "pool_all_completed": pool_run["all_completed"],
+        "pool_exactly_one_terminal":
+            not pool_run["duplicate_terminals"],
+        "swap_zero_requests_lost":
+            pool_run["swap"]["requests_lost"] == 0,
+        "swap_never_zero_ready": pool_run["swap"]["min_ready_seen"] >= 1,
+    }
+    if args.replicas >= 2:
+        checks["scaling_at_least_1_5x"] = (ratio is not None
+                                           and ratio >= 1.5)
+    report = {
+        "metric": "serve_soak_pool_qps",
+        "value": pool_run["qps"],
+        "unit": "jobs/s",
+        "baseline_qps": baseline["qps"],
+        "qps_ratio_vs_1_replica": ratio,
+        "phases": {"baseline": baseline, "pool": pool_run},
+        "backend": "dryrun",
+    }
+    if args.kill_replica:
+        chaos = _pool_burst(args.jobs, args.replicas, seed=args.seed,
+                            kill=True,
+                            label=f"kill-{args.replicas}x")
+        report["phases"]["kill"] = chaos
+        dead_s = chaos["kill"]["dead_visible_s"]
+        cadence = chaos["kill"]["sampler_cadence_s"]
+        checks.update({
+            "kill_all_completed": chaos["all_completed"],
+            "kill_exactly_one_terminal":
+                not chaos["duplicate_terminals"],
+            "kill_no_double_execution":
+                not chaos["duplicate_terminals"],
+            "kill_failover_happened": chaos["failovers_total"] >= 1,
+            # One sampler cadence, plus scheduling slack for the 1-core
+            # box (discovery is usually instant via dispatch failure).
+            "kill_dead_in_healthz_within_cadence":
+                dead_s is not None and dead_s <= cadence + 0.5,
+        })
+    report["checks"] = checks
+    verdict = all(checks.values())
+    out = args.out or "SERVE_SOAK_POOL.json"
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report), flush=True)
+    return 0 if verdict else 1
+
+
 # Mixed burst: single-image tasks, an NLVR2 pair, and a retrieval set —
 # the ragged backlog shape run_many's chunk packing exists for.
 PATTERN = [
@@ -156,15 +483,35 @@ PATTERN = [
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--jobs", type=int, default=96)
-    p.add_argument("--out", default="SERVE_SOAK.json")
+    p.add_argument("--out", default=None,
+                   help="artifact path (default SERVE_SOAK.json, or "
+                        "SERVE_SOAK_POOL.json in pool mode)")
     p.add_argument("--full", action="store_true",
                    help="serving-size model on whatever backend jax picks")
     p.add_argument("--chaos", action="store_true",
                    help="run under a seeded FaultPlan (remote worker mode) "
                         "and assert exactly-one-terminal-state per job")
     p.add_argument("--seed", type=int, default=0,
-                   help="FaultPlan seed (same seed → same schedule)")
+                   help="FaultPlan / chaos schedule seed (same seed → same "
+                        "schedule, same kill victim)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="replica-pool size; >1 switches to the pool soak "
+                        "(dryrun stub engines)")
+    p.add_argument("--dryrun", action="store_true",
+                   help="pool soak with stub engines (GIL-releasing sleep "
+                        "per row) — measures the serving planes, no model")
+    p.add_argument("--kill-replica", action="store_true",
+                   help="pool soak: add a seeded chaos burst that kills "
+                        "one replica mid-burst and asserts failover "
+                        "invariants")
     args = p.parse_args(argv)
+
+    if args.dryrun or args.replicas > 1 or args.kill_replica:
+        # Pool mode is dryrun by definition: replica scaling on a shared
+        # host only measures the dispatch plane with stub service times.
+        return run_pool_soak(args)
+    if args.out is None:
+        args.out = "SERVE_SOAK.json"
 
     if not args.full:
         import jax

@@ -684,6 +684,89 @@ def test_unbounded_buffer_off_obs_plane_is_clean():
     assert "VMT115" not in rules_hit(src)
 
 
+# ----------------------------------------------------------------- VMT117
+SERVE = "vilbert_multitask_tpu/serve/fake.py"  # on the serving plane
+
+
+def test_replica_handle_stored_on_self_triggers():
+    # The affinity pin: a checked-out handle surviving on the instance —
+    # the pool can drain/swap/kill that replica and this engine reference
+    # never hears about it.
+    src = """
+    class Dispatcher:
+        def __init__(self, pool):
+            self.pool = pool
+            self.rep = pool.checkout()
+
+        def dispatch(self, batch):
+            return self.rep.engine.run_many(batch)
+    """
+    assert "VMT117" in rules_hit(src, path=SERVE)
+
+
+def test_checkout_without_checkin_or_return_triggers():
+    # The slot leak: checkout with no checkin and no handoff — the
+    # replica's inflight budget never recovers.
+    src = """
+    def fire(pool, batch):
+        rep = pool.checkout()
+        return rep.engine.run_many(batch)
+    """
+    assert "VMT117" in rules_hit(src, path=SERVE)
+
+
+def test_checkout_checkin_pair_is_clean():
+    src = """
+    def fire(pool, batch):
+        rep = pool.checkout()
+        try:
+            out = rep.engine.run_many(batch)
+        except Exception as e:
+            pool.checkin(rep, ok=False, error=e)
+            raise
+        pool.checkin(rep, ok=True)
+        return out
+    """
+    assert "VMT117" not in rules_hit(src, path=SERVE)
+
+
+def test_seam_forwarding_helper_returning_handle_is_clean():
+    # A helper may hand the handle to its caller (who owns the checkin) —
+    # the scheduler's drain-aware checkout wrapper is this shape.
+    src = """
+    def checkout_with_drain(pool, stop):
+        while not stop.is_set():
+            try:
+                return pool.checkout(timeout_s=0.05)
+            except LookupError:
+                continue
+        raise LookupError("draining")
+    """
+    assert "VMT117" not in rules_hit(src, path=SERVE)
+
+
+def test_replica_affinity_off_serve_plane_is_clean():
+    # Scoped to serve/: bench/eval harnesses may hold an engine directly.
+    src = """
+    class Harness:
+        def __init__(self, pool):
+            self.rep = pool.checkout()
+    """
+    assert "VMT117" not in rules_hit(src)
+
+
+def test_pool_module_itself_is_exempt():
+    # pool.py implements the seam — its internals checkout/checkin across
+    # method boundaries by design.
+    src = """
+    def run(self, req):
+        rep = self.checkout()
+        return rep.engine.run(req)
+    """
+    assert "VMT117" not in rules_hit(
+        src, path="vilbert_multitask_tpu/serve/pool.py")
+
+
 # ----------------------------------------------- suppressions and baseline
 def test_inline_suppression_by_id_name_and_next_line():
     base = """

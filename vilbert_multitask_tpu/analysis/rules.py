@@ -1368,12 +1368,120 @@ class BlockingCallUnderSchedulerLock(Rule):
                             f"section")
 
 
+_POOL_MODULE_RE = re.compile(r"(^|[\\/])pool\.py$")
+
+
+class ReplicaAffinityLeak(Rule):
+    """A replica handle captured outside the pool's checkout/checkin seam.
+
+    The ReplicaPool's failover and rolling-swap guarantees rest on one
+    invariant: an engine handle leaves the pool ONLY through
+    ``checkout()`` and comes back through ``checkin()`` in the same
+    dispatch scope. A handle stored on ``self`` or at module level pins
+    work to one replica past the seam — the pool drains a replica the
+    stored handle keeps using (swap corrupts in-flight work), and a dead
+    replica's handle keeps receiving dispatches failover can never see.
+    A checkout whose result neither checks back in nor escapes via
+    return leaks the inflight slot outright: the replica's admission
+    budget never recovers and the pool slowly wedges. Scoped to serve/
+    (pool.py itself implements the seam and is exempt).
+    """
+
+    id = "VMT117"
+    name = "replica-affinity-leak"
+    severity = "error"
+    description = ("replica handle from pool.checkout() stored on self/"
+                   "module scope, or checked out with no checkin() and no "
+                   "return of the handle in the same function — the "
+                   "handle outlives the checkout/checkin seam, pinning "
+                   "work to a replica the pool may drain, swap, or "
+                   "declare dead")
+
+    @staticmethod
+    def _is_checkout(call: ast.AST) -> bool:
+        return (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "checkout")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _SCHED_PLANE_RE.search(ctx.rel_path):
+            return
+        if _POOL_MODULE_RE.search(ctx.rel_path):
+            return
+        # Module-level captures: `REP = pool.checkout()` pins forever.
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                if value is not None and any(
+                        self._is_checkout(n) for n in ast.walk(value)):
+                    yield self.finding(
+                        ctx, stmt, "replica handle checked out into module "
+                        "scope — it outlives every drain/swap/failover; "
+                        "checkout per dispatch and checkin in the same "
+                        "function")
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            checkouts = [n for n in ast.walk(fn)
+                         if self._is_checkout(n)
+                         and ctx.enclosing_function(n) is fn]
+            if not checkouts:
+                continue
+            has_checkin = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "checkin"
+                for n in ast.walk(fn))
+            # Local names bound to a checkout result (x = pool.checkout()).
+            handle_names: Set[str] = set()
+            stored: List[ast.AST] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not any(self._is_checkout(n)
+                           for n in ast.walk(node.value)):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        # self.rep = pool.checkout(...) — affinity pinned
+                        # on the instance, past the seam.
+                        stored.append(node)
+                    elif isinstance(tgt, ast.Name):
+                        handle_names.add(tgt.id)
+            for node in stored:
+                yield self.finding(
+                    ctx, node, "replica handle stored on an attribute — "
+                    "the engine stays pinned after the pool drains, "
+                    "swaps, or kills that replica; keep the handle local "
+                    "and checkin() in the same function")
+            if has_checkin:
+                continue
+            # No checkin: the function must at least hand the handle back
+            # to its caller (a seam-forwarding helper returns it).
+            # Only the handle ITSELF escaping counts (`return rep` /
+            # `return pool.checkout()`): returning a value computed FROM
+            # the handle (`return rep.engine.run(...)`) still strands it.
+            returns_handle = any(
+                isinstance(n, ast.Return) and n.value is not None
+                and (self._is_checkout(n.value)
+                     or (isinstance(n.value, ast.Name)
+                         and n.value.id in handle_names))
+                for n in ast.walk(fn))
+            if not returns_handle:
+                yield self.finding(
+                    ctx, checkouts[0], "checkout() with no checkin() and "
+                    "no return of the handle in this function — the "
+                    "replica's inflight slot leaks and its breaker never "
+                    "hears the outcome; pair every checkout with a "
+                    "checkin on both success and failure paths")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
          LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
          PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer,
-         BlockingCallUnderSchedulerLock]
+         BlockingCallUnderSchedulerLock, ReplicaAffinityLeak]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

@@ -434,6 +434,32 @@ class ServingConfig:
     # Graceful drain: how long stop() waits for the worker to finish
     # in-flight jobs before releasing them back to the queue.
     drain_grace_s: float = 10.0
+    # --- replica pool (serve/pool.py) ---
+    # Engine replicas behind the queue/scheduler seam: separate devices or
+    # mesh shards on hardware, CPU threads in dryrun. 1 keeps the
+    # single-engine data path but still health-gates it through the pool.
+    pool_replicas: int = 1
+    # How long checkout() waits for a ready replica before raising
+    # NoReadyReplica (jobs stay queued; the durable queue absorbs brief
+    # all-replicas-busy or rolling-swap windows).
+    pool_checkout_timeout_s: float = 30.0
+    # Dispatches a single replica may hold concurrently. 1 = strictly
+    # serial per replica (scaling comes from replica count alone).
+    pool_max_inflight_per_replica: int = 1
+    # Per-replica dispatch breaker: stricter than the engine's own funnel
+    # breaker — a replica that keeps failing leaves the rotation
+    # (ready→degraded) after this many failures in the window, and is
+    # probed again (half-open checkout) after the reset timeout.
+    pool_breaker_failure_threshold: int = 3
+    pool_breaker_window_s: float = 30.0
+    pool_breaker_reset_timeout_s: float = 5.0
+    # Rolling checkpoint swap: max seconds to wait for a draining replica's
+    # in-flight dispatches to finish before swapping params anyway.
+    pool_swap_drain_timeout_s: float = 30.0
+    # Total deliveries (claims) a job gets before the queue dead-letters
+    # it as poison — counts every redelivery, including visibility-timeout
+    # and release()-based failover redeliveries that charge no *attempt*.
+    queue_max_deliveries: int = 3
     # --- continuous-batching scheduler (serve/scheduler.py) ---
     # When enabled, run_forever drains through the pipelined three-stage
     # data plane (intake pool -> EDF window scheduler -> completion stage)

@@ -63,7 +63,11 @@ from vilbert_multitask_tpu.features.pipeline import (
 from vilbert_multitask_tpu.features.store import FeatureStore
 from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks, ViLBertOutput
 from vilbert_multitask_tpu.parallel import sharding as shd
-from vilbert_multitask_tpu.resilience import CircuitBreaker, DeadlineExceeded
+from vilbert_multitask_tpu.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    ReplicaKilled,
+)
 from vilbert_multitask_tpu.resilience.faults import fault_point
 from vilbert_multitask_tpu import assets, obs
 
@@ -134,8 +138,19 @@ class InferenceEngine:
         label_store: Optional[LabelMapStore] = None,
         mesh=None,
         seed: int = 0,
+        replica_id: Optional[str] = None,
     ):
         self.cfg = cfg or FrameworkConfig()
+        # Replica identity (serve/pool.py): None for standalone engines.
+        # Threads through the breaker name, live_stats keys, and forward
+        # spans so N same-process replicas stay distinguishable in every
+        # telemetry surface.
+        self.replica_id = replica_id
+        # Flipped by ReplicaPool.kill() (chaos) or by the pool when a
+        # health probe declares this replica dead: every subsequent
+        # dispatch fails fast with ReplicaKilled so in-flight batches fail
+        # over instead of completing against a corpse.
+        self.killed = False
         ecfg = self.cfg.engine
         self.compute_dtype = jnp.dtype(ecfg.compute_dtype)
         # Storage dtype of the served param tree (EngineConfig.param_dtype).
@@ -227,8 +242,10 @@ class InferenceEngine:
         # threshold is deliberately laxer than the transport breaker's —
         # one-off runtime errors (worst case: one bad request per window)
         # must not poison a shared engine.
+        breaker_name = ("engine.forward" if replica_id is None
+                        else f"engine.forward.{replica_id}")
         self._breaker = CircuitBreaker(
-            name="engine.forward", failure_threshold=8, window_s=60.0,
+            name=breaker_name, failure_threshold=8, window_s=60.0,
             reset_timeout_s=15.0)
         # Device input cache: encoded region tensors for content-stable
         # (store-backed) images, pinned in HBM after first use — the input
@@ -341,6 +358,28 @@ class InferenceEngine:
             )
 
         return jax.jit(_init)(rng)
+
+    def load_params(self, params) -> None:
+        """Hot-swap the served param tree (rolling checkpoint deploy).
+
+        The compiled programs take params as a call argument, not a
+        closure (``fwd(params, ...)``), so a same-shape tree swaps in with
+        ZERO recompiles: placement/cast mirrors ``__init__`` (shard under
+        a mesh, cast + device-pin otherwise) and the attribute assignment
+        is atomic — an in-flight forward finishes against the tree it
+        started with, the next dispatch reads the new one.
+        """
+        if self.mesh is not None:
+            params = shd.shard_params(params, self.mesh,
+                                      dtype=self.param_dtype)
+        else:
+            params = jax.device_put(
+                shd.cast_floating(params, self.param_dtype))
+        # Block BEFORE publishing: a half-uploaded tree must never be
+        # observable, and the swap caller's timing should measure the
+        # upload, not leak it into the next request's forward.
+        jax.block_until_ready(params)
+        self.params = params
 
     # -------------------------------------------------------------- compile
     # Max label-decode fanout (TaskSpec.top_k ≤ 3 for the labels family).
@@ -498,6 +537,9 @@ class InferenceEngine:
         not failure.
         """
         fault_point("engine.dispatch")
+        if self.killed:
+            raise ReplicaKilled(
+                f"engine replica {self.replica_id or '?'} is dead")
         self._breaker.preflight()
         try:
             result = self._dispatch_forward(bucket, collect_attention,
@@ -907,7 +949,8 @@ class InferenceEngine:
         # jax dispatch is async, so fencing on the fetch is what makes the
         # span (and forward_s) measure device time instead of enqueue time.
         with obs.span("engine.forward", bucket=req.bucket,
-                      task_id=req.spec.task_id):
+                      task_id=req.spec.task_id,
+                      replica=self.replica_id or ""):
             if self.mesh is not None:
                 # Mesh serving ships the batched tree with batch shardings (a
                 # local multi-chip host: PCIe upload is cheap; the row cache
@@ -1003,7 +1046,8 @@ class InferenceEngine:
                         on_result(pos, out[pos])
             dec_s += time.perf_counter() - td
 
-        with obs.span("engine.run_many", n_requests=len(reqs),
+        with obs.span("engine.run_many", replica=self.replica_id or "",
+                      n_requests=len(reqs),
                       n_chunks=len(chunks)):
             for c in chunks:
                 pending.append((c, self._dispatch_many([r for _, r in c])))

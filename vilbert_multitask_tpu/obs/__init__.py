@@ -79,6 +79,7 @@ __all__ = [
     "render_prometheus", "start_profile", "stop_profile",
     "SHED_COUNTER", "RETRY_COUNTER", "BREAKER_GAUGE", "DEADLINE_SLACK",
     "BATCH_FILL", "SCHED_WAIT", "QUEUE_WAIT", "BATCHES_DISPATCHED",
+    "REPLICA_STATE", "FAILOVER_COUNTER", "POISON_COUNTER",
     "SAMPLER_THREAD_NAME", "Sampler", "TimeSeriesStore",
     "RECORDER_THREAD_NAME", "FlightRecorder", "active_recorder",
     "clear_recorder", "install_recorder", "record_event", "record_spike",
@@ -138,6 +139,25 @@ QUEUE_WAIT = REGISTRY.histogram(
 BATCHES_DISPATCHED = REGISTRY.counter(
     "vmt_batches_dispatched_total",
     "Device chunks dispatched by the continuous-batching scheduler.",
+)
+
+# Replica-pool instruments (serve/pool.py).
+REPLICA_STATE = REGISTRY.gauge(
+    "vmt_replica_state",
+    "Replica health state: 0 booting, 1 warming, 2 ready, 3 degraded, "
+    "4 draining, 5 dead.",
+    labelnames=("replica",),
+)
+FAILOVER_COUNTER = REGISTRY.counter(
+    "vmt_failovers_total",
+    "In-flight jobs released back to the queue because their replica "
+    "died or tripped its breaker mid-dispatch.",
+    labelnames=("replica",),
+)
+POISON_COUNTER = REGISTRY.counter(
+    "vmt_poison_jobs_total",
+    "Jobs dead-lettered by the queue after exhausting queue_max_deliveries "
+    "total deliveries (poison-job quarantine).",
 )
 
 

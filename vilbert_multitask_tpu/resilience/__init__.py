@@ -10,11 +10,13 @@ only — no jax (layer contract enforced by vmtlint VMT112).
 from vilbert_multitask_tpu.resilience.policy import (
     AdmissionController,
     AdmissionDecision,
+    BreakerBoard,
     CircuitBreaker,
     CircuitOpenError,
     Deadline,
     DeadlineExceeded,
     PROCESS_RETRY_BUDGET,
+    ReplicaKilled,
     RetryBudget,
     RetryPolicy,
 )
@@ -31,11 +33,13 @@ from vilbert_multitask_tpu.resilience.faults import (
 __all__ = [
     "AdmissionController",
     "AdmissionDecision",
+    "BreakerBoard",
     "CircuitBreaker",
     "CircuitOpenError",
     "Deadline",
     "DeadlineExceeded",
     "PROCESS_RETRY_BUDGET",
+    "ReplicaKilled",
     "RetryBudget",
     "RetryPolicy",
     "FaultInjected",

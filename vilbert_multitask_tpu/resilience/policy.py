@@ -57,6 +57,19 @@ class CircuitOpenError(ConnectionError):
     """
 
 
+class ReplicaKilled(ConnectionError):
+    """An engine replica died (or was chaos-killed) with work in hand.
+
+    Subclasses :class:`ConnectionError` for the same reason as
+    :class:`CircuitOpenError`: to its callers a dead replica IS a lost
+    connection. The replica pool turns this into failover — in-flight
+    members go back to the queue via ``release()`` (no attempt charged;
+    infra death is not the job's fault) and redeliver to a live replica,
+    with ``queue_max_deliveries`` bounding how many replicas one poison
+    job may take down before it dead-letters.
+    """
+
+
 # --------------------------------------------------------------- deadlines
 class Deadline:
     """A monotonic time budget with a wall-clock wire form.
@@ -321,6 +334,48 @@ class CircuitBreaker:
             # it.
             obs.record_event("breaker_open", breaker=self.name,
                              cause=opened)
+
+
+class BreakerBoard:
+    """A family of same-shaped :class:`CircuitBreaker` instances, one per
+    member of a replica set.
+
+    The replica pool needs N independent breakers — one replica's dispatch
+    failures must trip ONLY that replica out of the rotation — but they
+    should share thresholds and publish under one gauge family
+    (``vmt_breaker_state{breaker="<prefix>.<member>"}``). ``get()`` is
+    idempotent per member name; iteration yields ``(member, breaker)``.
+    """
+
+    def __init__(self, prefix: str, *, failure_threshold: int = 3,
+                 window_s: float = 30.0, reset_timeout_s: float = 5.0,
+                 half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.prefix = prefix
+        self._kwargs = dict(failure_threshold=failure_threshold,
+                            window_s=window_s,
+                            reset_timeout_s=reset_timeout_s,
+                            half_open_probes=half_open_probes, clock=clock)
+        self._lock = threading.Lock()
+        self._members: Dict[str, CircuitBreaker] = {}
+
+    def get(self, member: str) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._members.get(member)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    name=f"{self.prefix}.{member}", **self._kwargs)
+                self._members[member] = breaker
+            return breaker
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            members = list(self._members.items())
+        return {name: b.state for name, b in members}
+
+    def __iter__(self):
+        with self._lock:
+            return iter(list(self._members.items()))
 
 
 # --------------------------------------------------------------- admission

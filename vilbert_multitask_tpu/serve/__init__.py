@@ -9,6 +9,12 @@ HTTP endpoints).
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.http_api import ApiServer
 from vilbert_multitask_tpu.serve.metrics import Metrics
+from vilbert_multitask_tpu.serve.pool import (
+    NoReadyReplica,
+    Replica,
+    ReplicaFailover,
+    ReplicaPool,
+)
 from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, Job, make_job_message
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
@@ -21,7 +27,11 @@ __all__ = [
     "DurableQueue",
     "Job",
     "Metrics",
+    "NoReadyReplica",
     "PushHub",
+    "Replica",
+    "ReplicaFailover",
+    "ReplicaPool",
     "ResultStore",
     "ServeWorker",
     "WebSocketBridge",

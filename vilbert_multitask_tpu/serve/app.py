@@ -22,6 +22,7 @@ from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 from vilbert_multitask_tpu.features.store import FeatureStore
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.http_api import ApiServer
+from vilbert_multitask_tpu.serve.pool import ReplicaPool
 from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge
 from vilbert_multitask_tpu.serve.queue import DurableQueue
 from vilbert_multitask_tpu.serve.worker import ServeWorker
@@ -50,7 +51,8 @@ class ServeApp:
         self.hub = PushHub()
         self.queue = DurableQueue(
             s.queue_db_path, queue_name=s.queue_name,
-            max_delivery_attempts=s.max_delivery_attempts)
+            max_delivery_attempts=s.max_delivery_attempts,
+            max_deliveries=s.queue_max_deliveries)
         self.store = ResultStore(s.results_db_path)
         if engine is None:
             # Multi-device host → serve through the dp×tp mesh; a 1-chip box
@@ -106,11 +108,33 @@ class ServeApp:
                 self.boot_info["live_extract"] = True
             t0 = time.perf_counter()
             with obs.span("serve.boot"):
-                engine = InferenceEngine(
-                    self.cfg, params=params, mesh=mesh, feature_store=store)
+                # pool_replicas engines share ONE param tree (engine 0
+                # commits it to device / the mesh; the rest reuse the
+                # committed arrays — random-init would otherwise give each
+                # replica different weights) and one feature store. Each
+                # keeps its own compile cache, input cache, and breaker.
+                engines = []
+                for i in range(max(1, s.pool_replicas)):
+                    engines.append(InferenceEngine(
+                        self.cfg, params=params, mesh=mesh,
+                        feature_store=store, replica_id=f"r{i}"))
+                    if params is None:
+                        params = engines[0].params
+                engine = engines
             self.boot_info["engine_init_s"] = round(
                 time.perf_counter() - t0, 1)
-        self.engine = engine
+        # The serving plane always programs against a ReplicaPool — with
+        # one replica it degenerates to a thin facade over the engine; the
+        # checkout/checkin seam, health states, and failover semantics stay
+        # identical at every pool size. Callers may inject a prebuilt
+        # engine, a list of engines, or an existing pool.
+        if isinstance(engine, ReplicaPool):
+            self.engine = engine
+        else:
+            engines = list(engine) if isinstance(engine, (list, tuple)) \
+                else [engine]
+            self.engine = ReplicaPool(engines, serving=s)
+        self.boot_info["replicas"] = [r.name for r in self.engine.replicas]
         self.worker = ServeWorker(self.engine, self.queue, self.store,
                                   self.hub, s)
         # Live-health plane (obs/): the time-series store + sampler, the
@@ -142,7 +166,8 @@ class ServeApp:
             self.queue, self.store, self.hub, s,
             metrics=self.worker.metrics, boot_info=self.boot_info,
             stats_fn=lambda: {"input_cache": self.engine.input_cache_stats},
-            slos=self.slos, timeseries=self.timeseries)
+            slos=self.slos, timeseries=self.timeseries,
+            pool=self.engine, swap_fn=self.rolling_swap)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
@@ -166,6 +191,19 @@ class ServeApp:
                 floor_ms=s.slo_slack_floor_ms,
                 error_budget=s.slo_slack_budget),
         ]
+        # One availability objective PER REPLICA, fed by the pool's
+        # labelled dispatch histograms: a single sick replica burns its
+        # own budget visibly instead of hiding inside the fleet average.
+        pool = self.engine
+        for rep in pool.replicas:
+            def counts(window_s: float, _name=rep.name,
+                       _ok=pool.dispatch_ms, _fail=pool.dispatch_fail):
+                return (_ok.window_count(window_s, replica=_name),
+                        _fail.window_count(window_s, replica=_name))
+            slos.append(obs.Slo(
+                f"replica_{rep.name}_availability",
+                f"dispatches on replica {rep.name} succeed", counts,
+                error_budget=s.slo_availability_budget))
         return obs.SloEvaluator(
             slos, fast_window_s=s.slo_fast_window_s,
             slow_window_s=s.slo_slow_window_s,
@@ -223,6 +261,35 @@ class ServeApp:
         self.boot_info["phase"] = ("ready" if prev_phase == "ready"
                                    else "booting")
 
+    def rolling_swap(self, checkpoint_path: Optional[str] = None,
+                     params=None) -> dict:
+        """Zero-downtime checkpoint swap across the replica pool.
+
+        Loads the new tree once (host-side), then walks the pool's
+        drain → load → ready sequence one replica at a time — at least one
+        replica stays ready throughout (n >= 2), and since HTTP ingest only
+        enqueues, no request observes the swap at all. Same-shape trees
+        swap with ZERO recompiles (compiled programs take params as a call
+        argument — engine.load_params)."""
+        if params is None:
+            if checkpoint_path is None:
+                raise ValueError("rolling_swap needs checkpoint_path or "
+                                 "params")
+            from vilbert_multitask_tpu.checkpoint import restore_params
+
+            params = restore_params(checkpoint_path,
+                                    mesh=self.engine.mesh,
+                                    dtype=self.cfg.engine.param_dtype)
+        t0 = time.perf_counter()
+        obs.record_event("rolling_swap_start",
+                         checkpoint=checkpoint_path or "<in-memory>")
+        report = self.engine.rolling_swap(
+            lambda eng: eng.load_params(params))
+        report["total_s"] = round(time.perf_counter() - t0, 3)
+        report["checkpoint"] = checkpoint_path or "<in-memory>"
+        self.boot_info["last_swap"] = report
+        return report
+
     def start(self, worker: bool = True) -> None:
         """Boot the tiers; ``worker=False`` serves HTTP/ws only (an external
         worker — serve/remote.py, or the chaos soak's scripted one — drains
@@ -248,6 +315,9 @@ class ServeApp:
         self.ws.start()
         self.api.ws_port = self.ws.bound_port
         self.http_port = self.api.start()
+        # Replicas still 'booting' here were never warmed (--no-warmup /
+        # test boots): admit them as ready, compile-at-request.
+        self.engine.mark_ready()
         if worker:
             self._worker_thread = threading.Thread(
                 target=self.worker.run_forever,

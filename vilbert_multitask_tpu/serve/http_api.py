@@ -51,6 +51,8 @@ class ApiServer:
         stats_fn=None,
         slos=None,
         timeseries=None,
+        pool=None,
+        swap_fn=None,
     ):
         self.queue = queue
         self.store = store
@@ -68,6 +70,12 @@ class ApiServer:
         # Optional live-stats callable merged into /metrics (ServeApp wires
         # the engine's device input-cache counters through this).
         self.stats_fn = stats_fn
+        # Replica pool (ServeApp wires its ReplicaPool through): /healthz
+        # reports per-replica states and readiness requires >=1 ready
+        # replica; POST /admin/swap triggers swap_fn (a zero-downtime
+        # rolling checkpoint swap).
+        self.pool = pool
+        self.swap_fn = swap_fn
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -197,7 +205,12 @@ class ApiServer:
         slo_states = self.slos.states() if self.slos is not None else {}
         paging = sorted(name for name, state in slo_states.items()
                         if state == obs.STATE_PAGE)
-        ready = not booting and not paging
+        # Replica-pool readiness: at least one replica must be taking
+        # work. Pool state is reconciled by the sampler's probe tick, so a
+        # killed replica shows up here within one sampler cadence.
+        no_replica = (self.pool is not None
+                      and self.pool.ready_count() == 0)
+        ready = not booting and not paging and not no_replica
         body: Dict[str, Any] = {
             "ok": ready,
             "queue": self.queue.counts(),
@@ -205,8 +218,12 @@ class ApiServer:
             "breakers": breakers,
             "slo": slo_states,
         }
+        if self.pool is not None:
+            body["replicas"] = self.pool.replicas_info()
+            body["ready_replicas"] = self.pool.ready_count()
         if not ready:
             body["reason"] = ("booting" if booting
+                              else "no_ready_replica" if no_replica
                               else f"slo_page:{','.join(paging)}")
         return (200 if ready else 503), body
 
@@ -514,6 +531,8 @@ class ApiServer:
                     self._handle_upload(raw, ctype)
                 elif path.startswith("/worker/"):
                     self._handle_worker(path, raw)
+                elif path == "/admin/swap":
+                    self._handle_admin_swap(raw)
                 elif path.startswith("/admin/"):
                     self._handle_admin_edit(path, raw)
                 elif path == "/debug/profile/start":
@@ -532,6 +551,40 @@ class ApiServer:
                     self._json(200 if res["ok"] else 409, res)
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _handle_admin_swap(self, raw: bytes):
+                """POST /admin/swap {checkpoint_path}: rolling zero-downtime
+                checkpoint swap across the replica pool (ServeApp wires
+                ``swap_fn``). Runs in this handler thread — the server is
+                threaded, so health/metrics/submits keep flowing while
+                replicas drain and reload one at a time. Same admin-token
+                gate as the admin edit surface."""
+                token = getattr(api.serving, "admin_token", None)
+                if token:
+                    import hmac
+
+                    auth = self.headers.get("Authorization", "")
+                    if not hmac.compare_digest(auth, f"Bearer {token}"):
+                        self._json(401, {"error": "bad admin token"})
+                        return
+                if api.swap_fn is None:
+                    self._json(409, {"error": "no swap handler wired"})
+                    return
+                try:
+                    p = json.loads(raw or b"{}")
+                except json.JSONDecodeError:
+                    self._json(400, {"error": "invalid JSON"})
+                    return
+                ckpt = p.get("checkpoint_path")
+                if not ckpt:
+                    self._json(400, {"error": "need checkpoint_path"})
+                    return
+                try:
+                    report = api.swap_fn(checkpoint_path=str(ckpt))
+                except (ValueError, FileNotFoundError, TimeoutError) as e:
+                    self._json(409, {"error": f"swap failed: {e}"})
+                    return
+                self._json(200, {"ok": True, "swap": report})
 
             def _handle_admin_edit(self, path: str, raw: bytes):
                 """Admin write surface (reference demo/admin.py:11-34: the
@@ -609,7 +662,14 @@ class ApiServer:
                             exclude=[int(x) for x in p.get("exclude", [])])
                         self._json(200, {"job": None if job is None else {
                             "id": job.id, "body": job.body,
-                            "attempts": job.attempts}})
+                            "attempts": job.attempts,
+                            "deliveries": job.deliveries}})
+                    elif path == "/worker/dead_letters":
+                        jobs = api.queue.pop_dead_letters()
+                        self._json(200, {"jobs": [
+                            {"id": j.id, "body": j.body,
+                             "attempts": j.attempts,
+                             "deliveries": j.deliveries} for j in jobs]})
                     elif path == "/worker/ack":
                         api.queue.ack(int(p["job_id"]))
                         self._json(200, {"ok": True})

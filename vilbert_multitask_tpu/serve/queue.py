@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Sequence
 
+from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.resilience.faults import fault_point
 
 
@@ -30,17 +31,33 @@ class Job:
     id: int
     body: Dict[str, Any]
     attempts: int
+    deliveries: int = 0
 
 
 class DurableQueue:
-    """Embedded durable queue with at-least-once delivery + dead-lettering."""
+    """Embedded durable queue with at-least-once delivery + dead-lettering.
+
+    Two independent poison bounds govern redelivery:
+
+    - ``max_delivery_attempts`` counts *charged* attempts (claims minus
+      releases) — the classic nack-toward-dead-letter path;
+    - ``max_deliveries`` counts TOTAL claims, release or not. It exists
+      because ``release()`` un-charges the attempt (graceful drain and
+      replica failover are not the job's fault), which would otherwise
+      reopen the reference's redeliver-forever loop for a job that crashes
+      every replica it lands on: such jobs release, redeliver, and crash
+      the next replica. After ``max_deliveries`` claims the job is
+      quarantined as dead regardless of its attempt balance.
+    """
 
     def __init__(self, path: str, *, queue_name: str = "vilbert_multitask_queue",
                  max_delivery_attempts: int = 3,
+                 max_deliveries: int = 3,
                  visibility_timeout_s: float = 300.0):
         self.path = path
         self.queue_name = queue_name
         self.max_delivery_attempts = max_delivery_attempts
+        self.max_deliveries = max_deliveries
         self.visibility_timeout_s = visibility_timeout_s
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -58,6 +75,19 @@ class DurableQueue:
             )
             c.execute("CREATE INDEX IF NOT EXISTS jobs_ready "
                       "ON jobs (queue, status, id)")
+            # Schema migration for pre-existing queue files: CREATE TABLE IF
+            # NOT EXISTS never adds columns, and serving state survives
+            # restarts by design.
+            cols = {r[1] for r in c.execute("PRAGMA table_info(jobs)")}
+            if "delivery_count" not in cols:
+                c.execute("ALTER TABLE jobs ADD COLUMN "
+                          "delivery_count INTEGER NOT NULL DEFAULT 0")
+            if "dead_notified" not in cols:
+                # 0 until some consumer has pushed the terminal dead_letter
+                # frame for this row; pop_dead_letters() flips it atomically
+                # so exactly one consumer notifies the client.
+                c.execute("ALTER TABLE jobs ADD COLUMN "
+                          "dead_notified INTEGER NOT NULL DEFAULT 0")
 
     def _conn(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self.path, timeout=30.0)
@@ -108,26 +138,40 @@ class DurableQueue:
                 "WHERE queue=? AND status='pending' AND attempts >= ?",
                 (self.queue_name, self.max_delivery_attempts),
             )
+            # Poison quarantine on TOTAL deliveries: release() un-charges
+            # the attempt, so a job that kills every replica it lands on
+            # (failover → release → redeliver) never trips the attempts
+            # bound above. delivery_count only ever increments.
+            poisoned = c.execute(
+                "UPDATE jobs SET status='dead', claimed_at=NULL "
+                "WHERE queue=? AND status='pending' AND delivery_count >= ?",
+                (self.queue_name, self.max_deliveries),
+            ).rowcount
             exclude = list(exclude)
             not_in = (
                 f" AND id NOT IN ({','.join('?' * len(exclude))})"
                 if exclude else ""
             )
             row = c.execute(
-                "SELECT id, body, attempts FROM jobs "
+                "SELECT id, body, attempts, delivery_count FROM jobs "
                 f"WHERE queue=? AND status='pending'{not_in} "
                 "ORDER BY id LIMIT 1",
                 (self.queue_name, *exclude),
             ).fetchone()
             if row is None:
+                if poisoned:
+                    obs.POISON_COUNTER.inc(poisoned)
                 return None
-            job_id, body, attempts = row
+            job_id, body, attempts, deliveries = row
             c.execute(
                 "UPDATE jobs SET status='inflight', attempts=attempts+1, "
-                "claimed_at=? WHERE id=?",
+                "delivery_count=delivery_count+1, claimed_at=? WHERE id=?",
                 (now, job_id),
             )
-            return Job(id=job_id, body=json.loads(body), attempts=attempts + 1)
+        if poisoned:
+            obs.POISON_COUNTER.inc(poisoned)
+        return Job(id=job_id, body=json.loads(body), attempts=attempts + 1,
+                   deliveries=deliveries + 1)
 
     def ack(self, job_id: int) -> None:
         """Success: remove the job (reference basic_ack, worker.py:650)."""
@@ -147,9 +191,13 @@ class DurableQueue:
                 return "gone"
             status = ("dead" if row[0] >= self.max_delivery_attempts
                       else "pending")
+            # An explicit nack's caller pushes the terminal frame itself
+            # (worker._fail_job) — mark notified so pop_dead_letters()
+            # never double-pushes for this row.
             c.execute(
-                "UPDATE jobs SET status=?, claimed_at=NULL WHERE id=?",
-                (status, job_id),
+                "UPDATE jobs SET status=?, claimed_at=NULL, "
+                "dead_notified=? WHERE id=?",
+                (status, 1 if status == "dead" else 0, job_id),
             )
             return status
 
@@ -193,11 +241,38 @@ class DurableQueue:
     def dead_jobs(self) -> list[Job]:
         with self._conn() as c:
             rows = c.execute(
-                "SELECT id, body, attempts FROM jobs "
+                "SELECT id, body, attempts, delivery_count FROM jobs "
                 "WHERE queue=? AND status='dead' ORDER BY id",
                 (self.queue_name,),
             ).fetchall()
-        return [Job(i, json.loads(b), a) for i, b, a in rows]
+        return [Job(i, json.loads(b), a, d) for i, b, a, d in rows]
+
+    def pop_dead_letters(self) -> list[Job]:
+        """Atomically take the dead jobs nobody has told the client about.
+
+        Claim-sweep dead-letters (worker crashed mid-job, or poison
+        quarantine after ``max_deliveries``) happen inside ``claim()``
+        where no caller holds the job body — so the terminal
+        ``dead_letter`` push can't be sent at the kill site. Consumers
+        call this after each claim; the notified flag flips inside one
+        BEGIN IMMEDIATE transaction so exactly one consumer pushes each
+        job's terminal frame (exactly-one-terminal survives multi-worker
+        and multi-replica claim races).
+        """
+        with self._conn() as c:
+            c.execute("BEGIN IMMEDIATE")
+            rows = c.execute(
+                "SELECT id, body, attempts, delivery_count FROM jobs "
+                "WHERE queue=? AND status='dead' AND dead_notified=0 "
+                "ORDER BY id",
+                (self.queue_name,),
+            ).fetchall()
+            if rows:
+                c.executemany(
+                    "UPDATE jobs SET dead_notified=1 WHERE id=?",
+                    [(r[0],) for r in rows],
+                )
+        return [Job(i, json.loads(b), a, d) for i, b, a, d in rows]
 
 
 def make_job_message(image_paths, question: str, task_id: int,

@@ -115,7 +115,23 @@ class RemoteQueue:
         if j is None:
             return None
         return Job(id=int(j["id"]), body=j["body"],
-                   attempts=int(j["attempts"]))
+                   attempts=int(j["attempts"]),
+                   deliveries=int(j.get("deliveries", 0)))
+
+    def pop_dead_letters(self) -> List[Job]:
+        """Poison-quarantine notifications (exactly-one-notifier: the web
+        host's ``dead_notified`` column hands each job to one caller).
+        Unreachable web host → empty list; the jobs stay claimable by the
+        next poll."""
+        try:
+            out = self._c.post("/worker/dead_letters", {})
+        except _NET_ERRORS as e:
+            log.warning("dead_letters unreachable (%s)", e)
+            return []
+        return [Job(id=int(j["id"]), body=j["body"],
+                    attempts=int(j["attempts"]),
+                    deliveries=int(j.get("deliveries", 0)))
+                for j in out.get("jobs", [])]
 
     def ack(self, job_id: int) -> None:
         try:

@@ -44,9 +44,11 @@ import math
 import queue as stdlib_queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 from vilbert_multitask_tpu import obs
+from vilbert_multitask_tpu.serve.pool import NoReadyReplica
 from vilbert_multitask_tpu.serve.push import log_to_terminal
 from vilbert_multitask_tpu.serve.queue import Job
 
@@ -169,6 +171,21 @@ class ContinuousScheduler:
                        "solo": 0}
         self._completions: stdlib_queue.Queue = stdlib_queue.Queue(
             maxsize=self.serving.sched_completion_depth)
+        # Replica-pool mode: when the worker's engine is a ReplicaPool
+        # (duck-typed on the checkout seam), batches PIN to one replica —
+        # checkout here, dispatch on an executor thread (one in-flight
+        # batch per replica slot), checkin in the dispatch task. The
+        # dispatch loop keeps selecting the next batch while replicas
+        # compute concurrently. Legacy single engines dispatch inline.
+        self.pool = (worker.engine
+                     if hasattr(worker.engine, "checkout") else None)
+        self._executor: Optional[ThreadPoolExecutor] = None
+        if self.pool is not None:
+            slots = (len(self.pool.replicas)
+                     * self.serving.pool_max_inflight_per_replica)
+            self._executor = ThreadPoolExecutor(
+                max_workers=max(1, slots),
+                thread_name_prefix="sched-dispatch")
 
     # -------------------------------------------------------- intake stage
     def _intake_loop(self) -> None:
@@ -255,9 +272,28 @@ class ContinuousScheduler:
                 return batch, expired
         return [], []
 
+    def _checkout_for_dispatch(self):
+        """Pool checkout that stays responsive to the drain signal: wait in
+        poll-interval slices up to the configured checkout timeout."""
+        deadline = self.clock() + self.serving.pool_checkout_timeout_s
+        while not self.stop.is_set():
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                break
+            try:
+                return self.pool.checkout(
+                    timeout_s=min(self.poll_interval_s, remaining))
+            except NoReadyReplica:
+                continue
+        raise NoReadyReplica("no ready replica before drain/timeout")
+
     def _dispatch(self, batch: List[ReadyItem]) -> None:
         """One fire: solos serve individually, the rest pack through
-        ``run_many`` with results streaming to the completion stage."""
+        ``run_many`` with results streaming to the completion stage.
+
+        Pool mode pins the packed batch to ONE checked-out replica and
+        runs it on the executor, so the dispatch loop can fire the next
+        batch onto another replica while this one computes."""
         now = self.clock()
         for item in batch:
             obs.SCHED_WAIT.observe(max(now - item.enq_t, 0.0) * 1e3)
@@ -270,11 +306,30 @@ class ContinuousScheduler:
             self.worker.step_one(item.job)
         if not packed:
             return
+        if self.pool is None:
+            self._dispatch_packed(packed, None)
+            return
+        try:
+            rep = self._checkout_for_dispatch()
+        except NoReadyReplica:
+            # Nothing can take the batch right now (swap-drain, breaker
+            # storm, or shutdown): release every member for redelivery —
+            # no attempt charged, and the delivery-count quarantine still
+            # bounds jobs that land here forever.
+            for item in packed:
+                self.worker._failover_job(item.job, "none")
+            return
+        self._executor.submit(self._dispatch_packed, packed, rep)
+
+    def _dispatch_packed(self, packed: List[ReadyItem], rep) -> None:
+        """Forward one packed batch on one engine (a checked-out replica,
+        or the worker's own engine in legacy mode) and stream results."""
+        engine = rep.engine if rep is not None else self.worker.engine
         reqs = [i.prepared for i in packed]
-        plan = self.worker.engine.chunk_plan([r.n_images for r in reqs])
+        plan = engine.chunk_plan([r.n_images for r in reqs])
         for idxs in plan:
             rows = sum(reqs[i].n_images for i in idxs)
-            bucket = self.worker.engine.cfg.engine.row_bucket_for(rows)
+            bucket = engine.cfg.engine.row_bucket_for(rows)
             obs.BATCH_FILL.observe(rows / bucket, bucket=str(bucket))
             obs.BATCHES_DISPATCHED.inc()
         with self._cond:
@@ -292,8 +347,9 @@ class ContinuousScheduler:
         try:
             t_fwd = time.perf_counter()
             with obs.span("worker.batch_forward", n_jobs=len(packed),
-                          job_ids=[i.job.id for i in packed]):
-                self.worker.engine.run_many(reqs, on_result=_on_result)
+                          job_ids=[i.job.id for i in packed],
+                          replica=rep.name if rep is not None else ""):
+                engine.run_many(reqs, on_result=_on_result)
             # Attribute the shared forward window into each member's own
             # trace (same contract as step_batch) so per-request
             # waterfalls stay contiguous under batching.
@@ -304,13 +360,25 @@ class ContinuousScheduler:
                     trace_id=item.job.body.get("trace_id"),
                     job_id=item.job.id, task_id=item.prepared.spec.task_id,
                     batched=True, n_jobs=len(packed))
-        except Exception:
+            if rep is not None:
+                self.pool.checkin(
+                    rep, ok=True,
+                    elapsed_ms=(time.perf_counter() - t_fwd) * 1e3)
+        except Exception as e:  # noqa: BLE001 — split below
+            if rep is not None:
+                self.pool.checkin(rep, ok=False, error=e)
+                rep.failovers += 1
             # Exactly-one-terminal: members that already streamed get
             # their terminal state from the completion stage; only the
-            # rest fail here.
+            # rest terminate here. With a pool the REPLICA is the suspect
+            # (release + redeliver; delivery_count bounds poison jobs) —
+            # legacy mode keeps the nack/dead-letter path.
             for pos, item in enumerate(packed):
                 if pos not in streamed:
-                    self.worker._fail_job(item.job)
+                    if rep is not None:
+                        self.worker._failover_job(item.job, rep.name)
+                    else:
+                        self.worker._fail_job(item.job)
 
     # ---------------------------------------------------- completion stage
     def _completion_loop(self) -> None:
@@ -359,17 +427,29 @@ class ContinuousScheduler:
             # completion queue finishes every already-forwarded result.
             for t in intakes:
                 t.join()
+            if self._executor is not None:
+                # In-flight replica batches finish (their results are
+                # already streaming into the completion queue) before the
+                # sentinel below — a shutdown must never orphan a batch
+                # between forward and persist.
+                self._executor.shutdown(wait=True)
             with self._cond:
                 leftovers = list(self._ready)
                 self._ready.clear()
                 self._stats["released"] += len(leftovers)
+            abandoned_by = (getattr(self.worker.engine, "replica_id", None)
+                            or "scheduler")
             for item in leftovers:
                 self.worker.queue.release(item.job.id)
+                obs.record_event("job_abandoned", job_id=item.job.id,
+                                 trace_id=item.job.body.get("trace_id"),
+                                 replica=abandoned_by)
                 log_to_terminal(
                     self.worker.hub, item.job.body.get("socket_id", ""),
                     {"terminal": "Server draining; job requeued for the "
                                  "next worker.",
                      "requeued": True,
+                     "abandoned_by": abandoned_by,
                      "question": item.job.body.get("question", "")})
                 self.worker._untrack(item.job.id)
             self._completions.put(None)

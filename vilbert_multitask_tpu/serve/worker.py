@@ -27,6 +27,7 @@ from vilbert_multitask_tpu.resilience import Deadline, DeadlineExceeded
 from vilbert_multitask_tpu.resilience.faults import fault_point
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.metrics import Metrics
+from vilbert_multitask_tpu.serve.pool import ReplicaFailover
 from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, Job
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
@@ -181,6 +182,7 @@ class ServeWorker:
         job came back (idle polls must not churn the span ring), and it
         joins the claimed job's trace after the fact (record_span)."""
         t0 = time.perf_counter()
+        self._notify_dead_letters()
         job = self.queue.claim(exclude=exclude)
         if job is not None:
             obs.default_tracer().record_span(
@@ -201,6 +203,57 @@ class ServeWorker:
             with self._inflight_lock:
                 self._inflight[job.id] = job
         return job
+
+    def _notify_dead_letters(self) -> None:
+        """Push terminal frames for jobs the queue quarantined as poison.
+
+        The deliveries sweep inside ``claim()`` dead-letters jobs that
+        exceeded ``queue_max_deliveries`` without any worker holding them —
+        nobody is positioned to tell the client.  ``pop_dead_letters()``
+        hands each such job to exactly one caller (the ``dead_notified``
+        column makes the pop idempotent), so the frame is pushed once no
+        matter how many workers poll."""
+        pop = getattr(self.queue, "pop_dead_letters", None)
+        if pop is None:
+            return
+        for job in pop():
+            obs.record_event("poison_quarantined", job_id=job.id,
+                             trace_id=job.body.get("trace_id"),
+                             task_id=job.body.get("task_id", ""),
+                             deliveries=job.deliveries)
+            log_to_terminal(
+                self.hub, job.body.get("socket_id", ""),
+                {"terminal": "Job quarantined: it was delivered "
+                             f"{job.deliveries} times without completing "
+                             "and will not be retried.",
+                 "error": "poison job dead-lettered after "
+                          f"{job.deliveries} deliveries",
+                 "dead_letter": True,
+                 "question": job.body.get("question", "")})
+
+    def _failover_job(self, job: Job, replica: str) -> str:
+        """Move a job off a failed replica: release (no attempt charged),
+        stamp the culprit replica in the requeued frame, and count it.
+
+        release(), not nack(): the REPLICA failed, not the job — at-least-
+        once redelivery reruns it on a healthy replica.  A job that kills
+        every replica it lands on is bounded by the queue's
+        ``delivery_count`` quarantine (release never decrements it)."""
+        obs.FAILOVER_COUNTER.inc(replica=replica)
+        obs.default_tracer().record_span(
+            "worker.failover", time.perf_counter(), 0.0,
+            trace_id=job.body.get("trace_id"), job_id=job.id,
+            replica=replica)
+        self.queue.release(job.id)
+        self._untrack(job.id)
+        log_to_terminal(
+            self.hub, job.body.get("socket_id", ""),
+            {"terminal": f"Replica {replica} failed mid-inference; job "
+                         "requeued on a healthy replica.",
+             "requeued": True,
+             "replica": replica,
+             "question": job.body.get("question", "")})
+        return "requeued"
 
     def _untrack(self, job_id: int) -> None:
         with self._inflight_lock:
@@ -343,6 +396,13 @@ class ServeWorker:
                     trace_id=job.body.get("trace_id"), job_id=job.id,
                     task_id=p.spec.task_id, batched=True,
                     n_jobs=len(packable))
+        except ReplicaFailover as e:
+            # The REPLICA died under this batch, not the jobs: release the
+            # whole batch for redelivery on a healthy replica. No member
+            # streamed (this path has no on_result), so none is terminal yet.
+            for job, _, _, _ in packable:
+                self._failover_job(job, e.replica)
+            return done
         except Exception:
             for job, _, _, _ in packable:
                 self._fail_job(job)
@@ -442,31 +502,43 @@ class ServeWorker:
             # The engine declined to dispatch — terminate, don't retry.
             self._expire_job(job)
             return "deadline"
+        except ReplicaFailover as e:
+            return self._failover_job(job, e.replica)
         except Exception:
             return self._fail_job(job)
         self.queue.ack(job.id)
         self._untrack(job.id)
         return "acked"
 
-    def abandon_inflight(self) -> int:
+    def abandon_inflight(self, replica: Optional[str] = None) -> int:
         """Graceful-drain tail: release every still-claimed job back to
         pending (no delivery attempt charged — release(), not nack()) and
         tell each client its job was requeued, not lost. Returns the count.
+
+        ``replica`` stamps WHO abandoned the job into the requeued frame
+        (postmortem provenance: /debug/trace shows which replica/worker a
+        bounced job last sat on). Defaults to the engine's replica id.
 
         At-least-once delivery makes this safe to call even for jobs that
         actually completed a moment ago: release() only touches rows still
         in 'inflight'.
         """
+        if replica is None:
+            replica = getattr(self.engine, "replica_id", None) or "worker"
         with self._inflight_lock:
             abandoned = list(self._inflight.values())
             self._inflight.clear()
         for job in abandoned:
             self.queue.release(job.id)
+            obs.record_event("job_abandoned", job_id=job.id,
+                             trace_id=job.body.get("trace_id"),
+                             replica=replica)
             log_to_terminal(
                 self.hub, job.body.get("socket_id", ""),
                 {"terminal": "Server draining; job requeued for the next "
                              "worker.",
                  "requeued": True,
+                 "abandoned_by": replica,
                  "question": job.body.get("question", "")})
         return len(abandoned)
 

@@ -110,13 +110,15 @@ def _parse_sweep(raw: str) -> tuple:
     return tuple(out)
 
 
-# Hardware default "64,128": round-5 showed dispatches are execute-bound
+# Hardware default "64,128,256": round-5 showed dispatches are execute-bound
 # (p50 flat from 1 to 10 rows), so the knee above the 32-row bucket is the
-# open throughput question and the driver's own run should answer it. Two
+# open throughput question and the driver's own run should answer it —
+# 256 rows brackets the analytic int8 knee (engine/flops.py:knee_rows)
+# from above, so the sweep can actually observe the verdict flip. Three
 # extra bucket compiles (~1-2 min amortized by the compile cache), per-size
 # isolated so a failure costs only its key. TINY smoke keeps no sweep.
 SWEEP_ROWS = _parse_sweep(
-    os.environ.get("BENCH_SWEEP_ROWS", "" if TINY else "64,128"))
+    os.environ.get("BENCH_SWEEP_ROWS", "" if TINY else "64,128,256"))
 
 
 def synth_regions(rng, cfg, n_boxes=100):
@@ -609,9 +611,11 @@ def run_measurement() -> None:
     )
     # MFU against the chip's peak dense bf16 rate (None off-TPU).
     from vilbert_multitask_tpu.engine.flops import (
+        knee_rows,
         param_tree_bytes,
         peak_flops_for,
         serving_roofline,
+        weight_bytes_per_row,
     )
 
     peak = peak_flops_for(device_kind)
@@ -619,12 +623,16 @@ def run_measurement() -> None:
            if peak else None)
     # Roofline context for the MFU numbers: every forward reads the whole
     # param tree from HBM, so small batches are weight-read-bound and a low
-    # measured MFU can be the ROOF, not a software gap. param_bytes also
-    # records which storage dtype served (bf16 mode halves it).
+    # measured MFU can be the ROOF, not a software gap. param_bytes sums the
+    # tree as actually stored (f32 / bf16 / int8 values + f32 scales), so it
+    # also records which storage dtype served; knee_rows is the analytic
+    # batch size where the verdict flips to compute-bound — the sweep's
+    # 64/128/256 chunks exist to bracket it with measurements.
     param_bytes = param_tree_bytes(engine.params)
     roof_batch = thr.get("batch_chunk_rows", max(stats["buckets"]))
     roofline = serving_roofline(cfg.model, cfg.engine, roof_batch,
                                 device_kind, param_bytes)
+    knee = knee_rows(cfg.model, cfg.engine, device_kind, param_bytes)
 
     print(json.dumps({
         "metric": "p50_latency_ms",
@@ -646,8 +654,12 @@ def run_measurement() -> None:
         **anatomy,
         "param_bytes": param_bytes,
         "param_dtype": cfg.engine.param_dtype,
+        "fused_task_heads": cfg.engine.fused_task_heads,
         "achievable_mfu": roofline["achievable_mfu"],
         "roofline": roofline["reason"],
+        "knee_rows": knee,
+        "weight_bytes_per_row": round(
+            weight_bytes_per_row(param_bytes, roof_batch), 1),
         "n_queries": stats["n_queries"],
         "buckets_timed": stats["buckets"],
         "init_s": round(init_s, 1),

@@ -48,6 +48,14 @@ echo "== failover smoke (replica pool: seeded kill, exactly-one-terminal) =="
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --replicas 2 --dryrun \
   --kill-replica --seed 7 --jobs 40 --out /tmp/POOL_SOAK.json || fail=1
 
+echo "== quant smoke (int8 storage parity + roofline-knee plumbing) =="
+# Tiny f32 vs int8 engine: quantized tree reads <0.35x the bytes, one
+# task per decode family stays within quantization noise through the
+# fused head path, and the analytic batch knee (bench.py knee_rows)
+# shrinks with the storage dtype.
+JAX_PLATFORMS=cpu python scripts/quant_smoke.py \
+  --out /tmp/QUANT_SMOKE.json || fail=1
+
 echo "== SLO smoke (live-health plane answers under load) =="
 # Boot → synthetic load → /debug/slo parses with every SLO evaluated
 # (both burn windows) and /healthz reports ready.

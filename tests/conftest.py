@@ -61,6 +61,8 @@ SLOW_TESTS = {
     "test_mesh_sharded_engine_matches_single_device",
     "test_transfer_dtype_follows_compute_dtype",
     "test_bf16_param_storage_decode_parity",
+    "test_int8_param_storage_decode_parity",
+    "test_fused_heads_match_per_head_decode_on_mixed_chunk",
     "test_device_input_cache_lru_eviction",
     "test_warmup_falls_back_to_xla_when_kernel_rejected",
     "test_input_cache_stats_counts",

@@ -767,6 +767,80 @@ def test_pool_module_itself_is_exempt():
         src, path="vilbert_multitask_tpu/serve/pool.py")
 
 
+# ----------------------------------------------------------------- VMT118
+def test_dequant_tree_outside_jit_triggers():
+    # The footprint refund: widening the whole int8 tree eagerly on the
+    # serve/boot plane recreates the fat copy int8 storage removed.
+    src = """
+    from vilbert_multitask_tpu import quant
+
+    def boot(params, dtype):
+        return quant.dequantize_tree(params, dtype)
+    """
+    assert "VMT118" in rules_hit(src)
+
+
+def test_handrolled_dequant_outside_jit_triggers():
+    src = """
+    import jax.numpy as jnp
+
+    def widen(pair):
+        return pair["int8"].astype(jnp.float32) * pair["scale"]
+    """
+    assert "VMT118" in rules_hit(src)
+
+
+def test_dequant_inside_jit_body_is_clean():
+    # The serving contract: dequant fuses into the consuming matmul
+    # inside the compiled program (engine/runtime.py _apply_heads).
+    src = """
+    import jax
+    from vilbert_multitask_tpu import quant
+
+    @jax.jit
+    def fwd(params, batch):
+        params = quant.dequantize_tree(params, "bfloat16")
+        return params
+    """
+    assert "VMT118" not in rules_hit(src)
+
+
+def test_dequant_in_method_referenced_from_jit_is_clean():
+    # The bound-alias closure (engine = self; engine._apply_heads(...))
+    # defeats the call graph; name-reference inside a jit body must count
+    # as traced — this is runtime.py's actual shape.
+    src = """
+    from functools import partial
+
+    import jax
+    from vilbert_multitask_tpu import quant
+
+    class Engine:
+        def _apply_heads(self, params, batch):
+            return quant.dequantize_tree(params, "bfloat16")
+
+        def _forward(self):
+            engine = self
+
+            @jax.jit
+            def fwd(params, batch):
+                return engine._apply_heads(params, batch)
+
+            return fwd
+    """
+    assert "VMT118" not in rules_hit(src)
+
+
+def test_quant_module_itself_is_exempt():
+    # dequantize_tree's own implementation calls dequantize_leaf per pair.
+    src = """
+    def dequantize_tree(params, dtype):
+        return dequantize_leaf(params, dtype)
+    """
+    assert "VMT118" not in rules_hit(
+        src, path="vilbert_multitask_tpu/quant.py")
+
+
 # ----------------------------------------------- suppressions and baseline
 def test_inline_suppression_by_id_name_and_next_line():
     base = """

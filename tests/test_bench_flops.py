@@ -27,7 +27,7 @@ def test_flops_estimate_vs_xla_cost_analysis(tiny_config, batch):
     eng = InferenceEngine(cfg, seed=0)
     d = eng._dummy_batch(batch)
     fwd = eng._forward(batch, False)
-    compiled = fwd.lower(eng.params, d).compile()
+    compiled = fwd.lower(eng.params, eng.head_slabs, d).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):  # older jax returns [dict]
         cost = cost[0]

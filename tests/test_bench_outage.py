@@ -131,3 +131,9 @@ def test_probe_skipped_in_tiny_mode():
                 if ln.startswith('{"metric"'))
     out = json.loads(line)
     assert isinstance(out["value"], (int, float)), r.stderr[-2000:]
+    # The roofline block ships on every headline, TINY included: the
+    # analytic batch knee and the per-row weight-read cost next to the
+    # param_bytes they derive from.
+    assert out["knee_rows"] >= 1
+    assert out["weight_bytes_per_row"] > 0
+    assert out["param_bytes"] > 0

@@ -84,6 +84,32 @@ def test_torch_roundtrip_lossless(tiny_cfg, tiny_params):
     assert report["missing"] == []
 
 
+def test_convert_int8_quantizes_after_f32_pack(tiny_cfg, tiny_params):
+    """dtype="int8" conversion packs every leaf at full f32 precision FIRST
+    and only then quantizes the finished tree — so dequantizing lands
+    within half a quantization step of the f32 conversion everywhere (a
+    raw ``np.asarray(x, "int8")`` leaf cast would truncate real weights to
+    garbage)."""
+    from vilbert_multitask_tpu import quant
+
+    sd = to_torch_state_dict(tiny_params, tiny_cfg)
+    q = convert_torch_state_dict(sd, tiny_cfg, dtype="int8")
+    assert quant.tree_is_quantized(q)
+    back = quant.dequantize_tree(q, np.float32)
+    flat_a = dict(_flat_paths(tiny_params))
+    flat_b = dict(_flat_paths(back))
+    assert flat_a.keys() == flat_b.keys()
+    for k in flat_a:
+        a = np.asarray(flat_a[k], np.float32)
+        if a.ndim >= 2:
+            amax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)))
+            assert np.all(np.abs(flat_b[k] - a) <= amax / 254.0 + 1e-7), k
+        else:  # vectors stay full precision, bit-exact
+            np.testing.assert_array_equal(a, flat_b[k], err_msg=str(k))
+    with pytest.raises(ValueError):
+        convert_torch_state_dict(sd, tiny_cfg, dtype="int16")
+
+
 def test_converted_params_run_and_match(tiny_cfg, tiny_params):
     """Converted tree drives the model to the same logits as the original."""
     model = ViLBertForVLTasks(tiny_cfg, dtype=jnp.float32)

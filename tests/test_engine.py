@@ -441,6 +441,140 @@ def test_bf16_param_storage_decode_parity(tiny_config):
         assert type(resbf) is type(res32)
 
 
+def test_int8_param_storage_decode_parity(tiny_config):
+    """EngineConfig.param_dtype="int8" quarters served-weight HBM; with
+    in-program dequant fused before each matmul, every decode family's
+    head must stay within per-channel quantization noise of the f32
+    engine. Tolerances are bumped over the bf16 gate — int8 carries ~3 bits
+    less mantissa than bf16 through a 12-layer trunk."""
+    import jax
+    import jax.numpy as jnp
+
+    from vilbert_multitask_tpu import quant
+    from vilbert_multitask_tpu.engine.flops import param_tree_bytes
+
+    eng32 = InferenceEngine(FrameworkConfig(
+        model=tiny_config, engine=_cpu_engine_cfg(max_regions=11)), seed=0)
+    host = jax.device_get(eng32.params)  # f32 masters, checkpoint-shaped
+    engq = InferenceEngine(FrameworkConfig(
+        model=tiny_config,
+        engine=dataclasses.replace(_cpu_engine_cfg(max_regions=11),
+                                   param_dtype="int8"),
+    ), params=host)
+    assert quant.tree_is_quantized(engq.params)
+    for leaf in jax.tree_util.tree_leaves(engq.params):
+        assert leaf.dtype in (jnp.int8, jnp.float32), leaf.dtype
+    # The roofline claim: int8 storage reads ~0.3× the f32 bytes (scales
+    # and untouched vector leaves keep it off the exact quarter).
+    ratio = param_tree_bytes(engq.params) / param_tree_bytes(eng32.params)
+    assert ratio < 0.35, ratio
+
+    feat_dim = tiny_config.v_feature_size
+    for task_id, spec in sorted(TASK_REGISTRY.items()):
+        regions = make_regions(spec.min_images, feat_dim=feat_dim,
+                               seed=40 + task_id)
+        question = spec.placeholder or "what is in the picture"
+        out32, res32 = eng32.run(eng32.prepare(task_id, question, regions))
+        outq, resq = engq.run(engq.prepare(task_id, question, regions))
+        head32 = np.asarray(
+            jax.device_get(getattr(out32, spec.head)), np.float32)
+        headq = np.asarray(
+            jax.device_get(getattr(outq, spec.head)), np.float32)
+        np.testing.assert_allclose(
+            headq, head32, rtol=0.15, atol=0.15,
+            err_msg=f"task {task_id} ({spec.name}) head {spec.head}")
+        assert resq.task_id == res32.task_id == task_id
+        assert type(resq) is type(res32)
+
+
+def test_fused_heads_match_per_head_decode_on_mixed_chunk(tiny_config):
+    """The fused decode-head program (one batched slab matmul + in-program
+    gather by task id) must decode a mixed-task run_many chunk to the same
+    answers as the per-head path (fused_task_heads=False) on the SAME
+    weights — answer order exact, confidences to f32 noise."""
+    import jax
+
+    fused = InferenceEngine(FrameworkConfig(
+        model=tiny_config, engine=_cpu_engine_cfg(max_regions=11)), seed=3)
+    assert fused.head_slabs is not None
+    host = jax.device_get(fused.params)
+    perhead = InferenceEngine(FrameworkConfig(
+        model=tiny_config,
+        engine=dataclasses.replace(_cpu_engine_cfg(max_regions=11),
+                                   fused_task_heads=False),
+    ), params=host)
+    assert perhead.head_slabs is None
+
+    regions = make_regions(4, feat_dim=tiny_config.v_feature_size, seed=5)
+    backlog = [
+        (1, "what is the man holding", 1),   # VQA labels
+        (12, "both images contain wolves", 2),  # NLVR2 pair
+        (7, "a red car parked outside", 4),  # retrieval ranking
+        (15, "is the bowl right of the mug", 1),  # GQA labels
+        (13, "a person entailed by a premise", 1),  # SNLI-VE trinary
+        (4, "which hand holds the phone", 1),  # Visual7W grounding
+    ]
+    res_a = fused.run_many([fused.prepare(t, q, regions[:n])
+                            for t, q, n in backlog])
+    res_b = perhead.run_many([perhead.prepare(t, q, regions[:n])
+                              for t, q, n in backlog])
+    assert [r.kind for r in res_a] == [r.kind for r in res_b]
+    for a, b in zip(res_a, res_b):
+        if a.answers is not None:
+            assert [x["answer"] for x in a.answers] == \
+                [x["answer"] for x in b.answers]
+            np.testing.assert_allclose(
+                [x["confidence"] for x in a.answers],
+                [x["confidence"] for x in b.answers], rtol=1e-4, atol=1e-6)
+        if a.ranking is not None:
+            assert [x["image"] for x in a.ranking] == \
+                [x["image"] for x in b.ranking]
+        if a.boxes is not None:
+            np.testing.assert_allclose(
+                [x["score"] for x in a.boxes],
+                [x["score"] for x in b.boxes], rtol=1e-4, atol=1e-6)
+
+
+def test_swap_requantizes_f32_checkpoint(tiny_config):
+    """POST /admin/swap regression: load_params on an int8 engine must
+    RE-QUANTIZE an incoming f32 host tree (restore_params ships f32 when
+    the checkpoint predates the storage mode) — and republish the fused
+    head slabs against the new tree atomically. A swap that silently
+    serves the fat tree defeats the storage mode without failing."""
+    import jax
+    import jax.numpy as jnp
+
+    from vilbert_multitask_tpu import quant
+
+    eng32 = InferenceEngine(FrameworkConfig(
+        model=tiny_config, engine=_cpu_engine_cfg(max_regions=11)), seed=0)
+    host = jax.device_get(eng32.params)
+    engq = InferenceEngine(FrameworkConfig(
+        model=tiny_config,
+        engine=dataclasses.replace(_cpu_engine_cfg(max_regions=11),
+                                   param_dtype="int8"),
+    ), params=host)
+    slabs_before = engq.head_slabs
+
+    bumped = jax.tree_util.tree_map(lambda x: x * 1.01, host)
+    engq.load_params(bumped)  # the rolling_swap load_fn path
+    assert quant.tree_is_quantized(engq.params)
+    for leaf in jax.tree_util.tree_leaves(engq.params):
+        assert leaf.dtype in (jnp.int8, jnp.float32), leaf.dtype
+    # Slabs republished against the swapped tree, and quantized kernels
+    # stay quantized through the swap.
+    assert engq.head_slabs is not slabs_before
+    assert quant.is_quantized_leaf(engq.head_slabs["label_d1_kernel"])
+    # An already-quantized tree round-trips through load_params untouched
+    # (the idempotent double-cast on the restore path).
+    requant = jax.device_get(engq.params)
+    engq.load_params(requant)
+    assert quant.tree_is_quantized(engq.params)
+    regions = make_regions(1, feat_dim=tiny_config.v_feature_size, seed=9)
+    _, res = engq.run(engq.prepare(1, "what is this", regions))
+    assert res.task_id == 1
+
+
 def test_transfer_dtype_follows_compute_dtype(tiny_config):
     """bf16 engines ship features as bf16 (half the host→device payload;
     bit-identical because the model casts at its first dense layer); f32

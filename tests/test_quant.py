@@ -1,0 +1,145 @@
+"""Per-channel int8 weight quantization (vilbert_multitask_tpu/quant.py):
+the storage mode behind ``EngineConfig.param_dtype="int8"``. The contract
+under test: a symmetric per-output-channel scheme whose round-trip error is
+bounded by half a quantization step per channel, pytree-transparent pairs
+(plain dicts — Orbax/device_put/tree_map all work untouched), idempotent
+tree quantization (the restore → load_params double-cast), and the byte
+halving vs bf16 the roofline work banks on."""
+
+import numpy as np
+import pytest
+
+from vilbert_multitask_tpu import quant
+from vilbert_multitask_tpu.engine.flops import param_tree_bytes
+from vilbert_multitask_tpu.parallel import sharding as shd
+
+
+def _tree(seed=0):
+    """Checkpoint-shaped host sample: matrices + an embedding table (both
+    quantize) and vector/scalar leaves (must pass through untouched)."""
+    r = np.random.RandomState(seed)
+    return {
+        "dense": {"kernel": r.randn(64, 32).astype(np.float32) * 0.07,
+                  "bias": r.randn(32).astype(np.float32)},
+        "qkv": {"kernel": r.randn(8, 64, 32).astype(np.float32)},
+        "embed": {"embedding": r.randn(1037, 48).astype(np.float32)},
+        "norm": {"scale": np.ones(32, np.float32)},
+    }
+
+
+# Quantization step is amax/127; symmetric rounding error is half a step.
+@pytest.mark.parametrize("shape", [(64, 32), (8, 64, 32), (1037, 48)])
+def test_round_trip_error_bounded_per_channel(shape):
+    x = (np.random.RandomState(hash(shape) % 2**31)
+         .randn(*shape).astype(np.float32))
+    back = quant.dequantize_leaf(quant.quantize_leaf(x), np.float32)
+    amax = np.max(np.abs(x), axis=tuple(range(x.ndim - 1)))
+    assert np.all(np.abs(back - x) <= amax / 254.0 + 1e-7)
+
+
+def test_zero_channel_guard():
+    """An all-zero output channel must round-trip to exact zeros (scale
+    falls back to 1.0, never 0/0)."""
+    x = np.random.RandomState(3).randn(16, 8).astype(np.float32)
+    x[:, 5] = 0.0
+    pair = quant.quantize_leaf(x)
+    assert float(pair[quant.QSCALE][5]) == 1.0
+    back = quant.dequantize_leaf(pair, np.float32)
+    assert np.all(back[:, 5] == 0.0)
+
+
+def test_quantize_tree_leaves_vectors_floating():
+    q = quant.quantize_tree(_tree())
+    assert quant.is_quantized_leaf(q["dense"]["kernel"])
+    assert quant.is_quantized_leaf(q["embed"]["embedding"])
+    assert q["dense"]["bias"].dtype == np.float32  # ndim<2: untouched
+    assert q["norm"]["scale"].dtype == np.float32
+    assert q["qkv"]["kernel"][quant.QVALUES].dtype == np.int8
+    # Scales are per-LAST-axis channels, f32.
+    assert q["qkv"]["kernel"][quant.QSCALE].shape == (32,)
+    assert q["qkv"]["kernel"][quant.QSCALE].dtype == np.float32
+    assert quant.tree_is_quantized(q) and not quant.tree_is_quantized(_tree())
+
+
+def test_quantize_tree_is_idempotent():
+    """restore_params(dtype="int8") → engine.load_params re-casts the tree;
+    the second pass must be the identity, not a double quantization."""
+    q1 = quant.quantize_tree(_tree())
+    q2 = quant.quantize_tree(q1)
+    assert np.array_equal(q1["dense"]["kernel"][quant.QVALUES],
+                          q2["dense"]["kernel"][quant.QVALUES])
+    assert np.array_equal(q1["embed"]["embedding"][quant.QSCALE],
+                          q2["embed"]["embedding"][quant.QSCALE])
+
+
+def test_dequantize_tree_expands_pairs_and_casts_rest():
+    q = quant.quantize_tree(_tree())
+    wide = quant.dequantize_tree(q, np.float32)
+    assert wide["dense"]["kernel"].shape == (64, 32)
+    assert wide["dense"]["kernel"].dtype == np.float32
+    assert wide["dense"]["bias"].dtype == np.float32
+    assert not quant.tree_is_quantized(wide)
+
+
+def test_int8_tree_bytes_near_quarter_of_f32():
+    """The roofline claim: int8 storage reads ~¼ the HBM bytes of f32 (the
+    f32 scale vectors and untouched bias/LN leaves cost a few points)."""
+    t = _tree()
+    ratio = param_tree_bytes(quant.quantize_tree(t)) / param_tree_bytes(t)
+    assert 0.25 <= ratio < 0.35, ratio
+
+
+def test_cast_floating_int8_mode_and_rejection():
+    """parallel/sharding.cast_floating is the ONE storage-cast seam: "int8"
+    routes to the quantizer, other integer dtypes are a config error, and
+    float casts pass quantized pairs through rather than casting the int8
+    values to float."""
+    t = _tree()
+    q = shd.cast_floating(t, "int8")
+    assert quant.tree_is_quantized(q)
+    again = shd.cast_floating(q, "int8")  # the double-cast seam
+    assert np.array_equal(q["dense"]["kernel"][quant.QVALUES],
+                          again["dense"]["kernel"][quant.QVALUES])
+    still = shd.cast_floating(q, "bfloat16")
+    assert still["dense"]["kernel"][quant.QVALUES].dtype == np.int8
+    with pytest.raises(ValueError):
+        shd.cast_floating(t, "int32")
+
+
+def test_spec_for_replicates_scale_vectors():
+    """Sharding rules match on the path with the pair suffix stripped, so a
+    kernel's int8 values shard like the kernel did and its (last_dim,)
+    scale vector falls through to replication."""
+    import jax
+
+    from vilbert_multitask_tpu.config import MeshConfig
+    from vilbert_multitask_tpu.parallel import build_mesh
+    from vilbert_multitask_tpu.parallel.sharding import param_specs
+
+    q = quant.quantize_tree({
+        "bert": {"encoder": {"t_layer_0": {"ffn": {"output": {
+            "kernel": np.zeros((64, 32), np.float32)}}}}}})
+    mesh = build_mesh(MeshConfig(dp=jax.device_count(), tp=1))
+    specs = param_specs(q, mesh)
+    pair = specs["bert"]["encoder"]["t_layer_0"]["ffn"]["output"]["kernel"]
+    assert tuple(pair[quant.QVALUES]) in (("tp", None), ())
+    assert tuple(pair[quant.QSCALE]) == ()
+
+
+def test_quantize_under_jit_matches_host():
+    """The device path (_place_params jits quantize_tree so eager scalar
+    constants never become implicit transfers) must agree bit-for-bit with
+    the host numpy path on the same values."""
+    import jax
+    import jax.numpy as jnp
+
+    t = _tree(9)
+    host = quant.quantize_tree(t)
+    dev = jax.jit(quant.quantize_tree)(
+        jax.tree_util.tree_map(jnp.asarray, t))
+    np.testing.assert_array_equal(
+        host["dense"]["kernel"][quant.QVALUES],
+        np.asarray(dev["dense"]["kernel"][quant.QVALUES]))
+    np.testing.assert_allclose(
+        host["embed"]["embedding"][quant.QSCALE],
+        np.asarray(dev["embed"]["embedding"][quant.QSCALE]), rtol=1e-6)

@@ -1476,12 +1476,100 @@ class ReplicaAffinityLeak(Rule):
                     "checkin on both success and failure paths")
 
 
+# --------------------------------------------------------------------- 118
+_QUANT_IMPL_RE = re.compile(r"(^|/)quant\.py$")
+_DEQUANT_FUNCS = ("quant.dequantize_tree", "quant.dequantize_leaf")
+
+
+class DequantOutsideJit(Rule):
+    """Host-side dequantization of an int8-quantized param tree.
+
+    The point of ``param_dtype="int8"`` is that weight HBM reads stay one
+    byte per element: the jitted forward dequantizes in-program
+    (engine/runtime.py ``_apply_heads``) so XLA fuses
+    ``values.astype(compute) * scale`` into the consuming matmul and no
+    fat copy ever exists. Calling ``quant.dequantize_tree`` /
+    ``dequantize_leaf`` — or hand-rolling ``pair["int8"].astype(...)`` —
+    OUTSIDE a jit boundary materializes the widened tree eagerly
+    (host-side: a full second tree in RAM plus a fat re-upload; eager
+    device-side: a standing 4× copy), silently refunding everything int8
+    storage bought. quant.py itself (the implementation) is exempt, as is
+    any function the jit plane provably or plausibly traces: lexical jit
+    bodies, call-graph-traced helpers, and functions whose name is
+    referenced inside a jit body of the same module (the bound-alias
+    ``engine = self`` closure pattern the call graph cannot resolve).
+    """
+
+    id = "VMT118"
+    name = "dequant-outside-jit"
+    severity = "error"
+    description = ("quant.dequantize_tree/dequantize_leaf (or a hand-"
+                   "rolled pair['int8'].astype(...)) called outside any "
+                   "jit boundary — the widened tree materializes eagerly, "
+                   "defeating int8 weight storage; dequantize inside the "
+                   "compiled program instead")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if _QUANT_IMPL_RE.search(ctx.rel_path):
+            return
+        traced: Set[int] = {id(info.body) for info in ctx.jit_bodies}
+        if ctx.project is not None:
+            traced |= {id(info.body)
+                       for info, _ in ctx.project.traced_helpers(ctx)}
+        # Names referenced inside any jit body here: methods invoked
+        # through a captured self-alias inherit traced context even though
+        # the call graph cannot prove it. Generous by design — this rule
+        # polices the serve/boot/bench planes, not the forward builders.
+        referenced: Set[str] = set()
+        for info in ctx.jit_bodies:
+            for n in ast.walk(info.body):
+                if isinstance(n, ast.Attribute):
+                    referenced.add(n.attr)
+                elif isinstance(n, ast.Name):
+                    referenced.add(n.id)
+
+        def is_traced(node: ast.AST) -> bool:
+            for anc in ctx.ancestors(node):
+                if id(anc) in traced:
+                    return True
+                if (isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and anc.name in referenced):
+                    return True
+            return False
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved.endswith(_DEQUANT_FUNCS):
+                if not is_traced(node):
+                    yield self.finding(
+                        ctx, node, f"`{resolved.rsplit('.', 1)[-1]}` "
+                        f"outside any jit boundary widens the whole int8 "
+                        f"tree eagerly — a standing fat copy per call; "
+                        f"dequantize inside the compiled forward (or wrap "
+                        f"the call in jax.jit) so HBM reads stay int8")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "astype"
+                  and isinstance(node.func.value, ast.Subscript)
+                  and isinstance(node.func.value.slice, ast.Constant)
+                  and node.func.value.slice.value == "int8"):
+                if not is_traced(node):
+                    yield self.finding(
+                        ctx, node, "hand-rolled dequant "
+                        "(pair['int8'].astype(...)) outside any jit "
+                        "boundary — use quant.dequantize_leaf inside the "
+                        "compiled program so the widening fuses into the "
+                        "consuming matmul")
+
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
          LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
          PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer,
-         BlockingCallUnderSchedulerLock, ReplicaAffinityLeak]
+         BlockingCallUnderSchedulerLock, ReplicaAffinityLeak,
+         DequantOutsideJit]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

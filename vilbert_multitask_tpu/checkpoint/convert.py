@@ -211,7 +211,18 @@ def convert_torch_state_dict(
     are reported there instead of silently dropped. ``dtype`` is the param
     storage dtype (float32 for serving; the conversion-oracle tests use
     float64 so parity tolerances sit far below perturbation signals).
+    ``dtype="int8"`` converts at full f32 precision first and then runs the
+    per-channel symmetric quantizer (quant.py) over the finished tree — a
+    direct ``np.asarray(x, "int8")`` cast would truncate real weights to
+    garbage, so the integer path never reaches the per-leaf cast below.
     """
+    quantize = dtype is not None and np.dtype(dtype).kind in "iu"
+    if quantize:
+        if np.dtype(dtype) != np.int8:
+            raise ValueError(
+                f"integer storage dtype {np.dtype(dtype)} unsupported; only "
+                "int8 per-channel quantization is implemented")
+        dtype = np.float32
     params: Dict = {}
     used: set = set()
     missing: List[str] = []
@@ -223,6 +234,10 @@ def convert_torch_state_dict(
             continue
         used.update(torch_keys)
         _set_path(params, flax_path, np.asarray(pack(*args), dtype))
+    if quantize:
+        from vilbert_multitask_tpu import quant
+
+        params = quant.quantize_tree(params)
     if strict and missing:
         raise KeyError(f"torch checkpoint missing {len(missing)} keys, "
                        f"e.g. {missing[:5]}")
@@ -253,7 +268,8 @@ def load_torch_checkpoint(path: str, cfg: ViLBertConfig, *,
     CPU-mapped, mirroring the reference's load (worker.py:83,530-532).
     ``dtype`` feeds :func:`convert_torch_state_dict`'s leaf cast — keep the
     f32 default for conversion-to-master-checkpoint flows; a serving-only
-    conversion may pass the engine's param_dtype to skip the second cast.
+    conversion may pass the engine's param_dtype (including ``"int8"``,
+    which quantizes the finished f32 tree) to skip the second cast.
     """
     import torch
 

@@ -41,9 +41,12 @@ def restore_params(path: str, *, mesh=None, like: Optional[Any] = None,
 
     ``dtype`` is the serving param-storage cast (EngineConfig.param_dtype,
     e.g. ``"bfloat16"``): floating leaves cast HOST-side before the upload,
-    so a bf16 restore ships half the checkpoint bytes. Checkpoints on disk
-    stay f32 masters — training restores (:func:`restore_train_state`)
-    never take this path and never downcast.
+    so a bf16 restore ships half the checkpoint bytes. ``dtype="int8"``
+    quantizes host-side instead (quant.py per-channel pairs), shipping ~¼
+    of the f32 bytes; a checkpoint saved from an int8 engine restores
+    unchanged because the cast is idempotent over quantized pairs.
+    Checkpoints on disk stay f32 masters — training restores
+    (:func:`restore_train_state`) never take this path and never downcast.
     """
     import orbax.checkpoint as ocp
 

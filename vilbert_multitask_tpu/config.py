@@ -250,9 +250,20 @@ class EngineConfig:
     # mesh placement all cast to it). "bfloat16" halves every weight read —
     # at serving batch sizes the forward is weight-read-bound (see
     # engine/flops.py roofline), so this is the serving-latency knob — and
-    # halves the boot upload. Training is unaffected: the trainer owns its
-    # own f32 master tree, and checkpoints on disk stay f32.
+    # halves the boot upload. "int8" halves it AGAIN: floating matrix
+    # leaves are stored as per-channel symmetric {"int8", "scale"} pairs
+    # (quant.py) and dequantized inside the jitted forward right before
+    # each matmul, so HBM reads stay int8. Training is unaffected: the
+    # trainer owns its own f32 master tree, and checkpoints on disk stay
+    # f32 — quantization happens at the serving cast seam only.
     param_dtype: str = "float32"
+    # Run the nine per-task decode heads as ONE batched program (stacked
+    # weight slabs + in-program gather by task id, engine/runtime.py)
+    # instead of nine sequential small matmuls. Mixed-task chunks stop
+    # fragmenting into per-head dispatches; numerics match the per-head
+    # path to LayerNorm rounding (~1e-6 f32). Off → the round-3 per-head
+    # path, which the parity tests pin against.
+    fused_task_heads: bool = True
     # Default ON (round 3): serving runs the flash co-attention kernel on
     # TPU; bench.py probe-compiles it and degrades to the XLA path if Mosaic
     # rejects it on the current backend. Off-TPU the kernel runs in

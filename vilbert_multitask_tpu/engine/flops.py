@@ -138,12 +138,43 @@ def peak_hbm_bw_for(device_kind: str) -> float | None:
 
 def param_tree_bytes(params) -> int:
     """Total bytes of a device param tree — the weight-read term of the
-    serving roofline (every forward reads every parameter once)."""
+    serving roofline (every forward reads every parameter once).
+
+    Dtype-aware by construction: it sums what the tree actually stores, so
+    an int8 tree (quant.py ``{"int8", "scale"}`` pairs — 1-byte values plus
+    their f32 scale vectors) reports its real HBM footprint, bf16 reports
+    half of f32, with no per-mode special casing."""
     import jax
 
     return int(sum(
         leaf.size * jax.numpy.dtype(leaf.dtype).itemsize
         for leaf in jax.tree_util.tree_leaves(params)))
+
+
+def weight_bytes_per_row(param_bytes: int, batch: int) -> float:
+    """HBM weight bytes amortized per batch row at ``batch`` — the number
+    bigger batches and smaller storage dtypes both shrink; emitted in the
+    bench roofline block next to ``param_bytes``."""
+    return param_bytes / max(1, batch)
+
+
+def knee_rows(mcfg: ViLBertConfig, ecfg: EngineConfig, device_kind: str,
+              param_bytes: int) -> int:
+    """The batch size where the roofline verdict flips from
+    weight-read-bound to compute-bound: the smallest ``batch`` with
+    ``t_compute >= t_mem``. FLOPs are linear in batch
+    (:func:`serving_forward_flops`) while the weight-read term is flat, so
+    the knee is analytic: ``ceil(param_bytes · peak / (bw · flops_per_row))``.
+    Unknown device kinds (CPU smoke runs) compute against the v5e
+    reference, same substitution as :func:`serving_roofline`."""
+    import math
+
+    peak = peak_flops_for(device_kind)
+    bw = peak_hbm_bw_for(device_kind)
+    if peak is None or bw is None:
+        _, peak, bw = _REFERENCE_CHIP
+    flops_per_row = serving_forward_flops(mcfg, ecfg, 1)
+    return max(1, math.ceil(param_bytes * peak / (bw * flops_per_row)))
 
 
 def serving_roofline(mcfg: ViLBertConfig, ecfg: EngineConfig, batch: int,

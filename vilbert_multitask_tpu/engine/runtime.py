@@ -61,8 +61,17 @@ from vilbert_multitask_tpu.features.pipeline import (
     encode_image,
 )
 from vilbert_multitask_tpu.features.store import FeatureStore
-from vilbert_multitask_tpu.models.vilbert import ViLBertForVLTasks, ViLBertOutput
+from vilbert_multitask_tpu.models.heads import (
+    SERVING_HEAD_MODULES,
+    build_head_slabs,
+)
+from vilbert_multitask_tpu.models.vilbert import (
+    ViLBertForVLTasks,
+    ViLBertOutput,
+    fused_head_output,
+)
 from vilbert_multitask_tpu.parallel import sharding as shd
+from vilbert_multitask_tpu import quant
 from vilbert_multitask_tpu.resilience import (
     CircuitBreaker,
     DeadlineExceeded,
@@ -156,13 +165,18 @@ class InferenceEngine:
         # Storage dtype of the served param tree (EngineConfig.param_dtype).
         # bf16 halves every weight read at serving shapes — where the MXU is
         # weight-read-bound, that is the roofline (see engine/flops.py) —
-        # and halves the one-time boot upload. Training never sees this:
-        # the trainer builds/restores its own f32 master tree.
+        # and halves the one-time boot upload. "int8" halves it again:
+        # floating matrix leaves become per-channel {"int8", "scale"} pairs
+        # (quant.py) and the jitted forward dequantizes them in-program
+        # right before the matmuls, so HBM reads stay int8. Training never
+        # sees this: the trainer builds/restores its own f32 master tree.
         self.param_dtype = jnp.dtype(ecfg.param_dtype)
-        if not jnp.issubdtype(self.param_dtype, jnp.floating):
+        self.param_quantized = self.param_dtype == jnp.dtype(jnp.int8)
+        if not (self.param_quantized
+                or jnp.issubdtype(self.param_dtype, jnp.floating)):
             raise ValueError(
-                f"engine.param_dtype must be a floating dtype, got "
-                f"{ecfg.param_dtype!r}")
+                f"engine.param_dtype must be a floating dtype or 'int8', "
+                f"got {ecfg.param_dtype!r}")
         # Engine kernel knobs win over the model config, unconditionally —
         # kernel selection must not depend on which config carried a flag.
         model_cfg = dataclasses.replace(
@@ -195,6 +209,17 @@ class InferenceEngine:
         self.mesh = mesh
         if ecfg.compilation_cache_dir:
             _enable_compilation_cache(ecfg.compilation_cache_dir)
+        # Task-id → label-head gather table for the fused decode program
+        # (index 1 = the GQA head, 0 = the VQA head): a static python tuple
+        # the jitted _fused_bundle embeds as a tiny constant.
+        n_tasks = max(TASK_REGISTRY) + 1
+        self._gqa_gather = tuple(
+            1 if (t in TASK_REGISTRY
+                  and TASK_REGISTRY[t].head == "vil_prediction_gqa") else 0
+            for t in range(n_tasks))
+        # The fused head-slab stacking program, built before the first
+        # params publish below (the setter runs it when fused heads are on).
+        self._head_slab_builder = self._make_head_slab_builder()
         if params is None:
             # One-time boot transfer: PRNGKey materializes its seed scalar
             # host→device. Explicitly allowed so engine construction stays
@@ -204,19 +229,7 @@ class InferenceEngine:
             with jax.transfer_guard("allow"):
                 boot_key = jax.random.PRNGKey(seed)
             params = self.init_params(boot_key)
-        if mesh is not None:
-            params = shd.shard_params(params, mesh, dtype=self.param_dtype)
-        else:
-            # Device-pin the tree ONCE, mirroring the reference's one-time
-            # ``model.cuda(0)`` (worker.py:534-536). Without this, every
-            # jitted forward re-uploads ~1 GB of f32 weights host→TPU —
-            # measured at 23.7 s/query over the remote-TPU link in round 2.
-            # Already-committed device arrays (the init_params path) pass
-            # through for free; host trees (checkpoint restores, test
-            # fixtures) cast to param_dtype host-side (halving the bf16
-            # upload) and move exactly once here.
-            params = jax.device_put(
-                shd.cast_floating(params, self.param_dtype))
+        params = self._place_params(params)
         jax.block_until_ready(params)
         self.params = params
         # keyed ('batched'|'rows', bucket, collect_attention, model_gen) —
@@ -265,6 +278,88 @@ class InferenceEngine:
         self._slab_scratch_n = 0
         self._scratch_next = 0
         self._slab_insert_fn = None
+
+    # ----------------------------------------------------- served tree state
+    # The served weights publish as ONE attribute write of a (params,
+    # head_slabs) pair, so a dispatch can never observe a new tree with the
+    # previous tree's fused head slabs (or vice versa) mid-swap.
+
+    @property
+    def params(self):
+        """The served param tree (published atomically with its fused
+        head slabs — see :meth:`load_params`)."""
+        return self._served[0]
+
+    @params.setter
+    def params(self, tree):
+        # Head-less trees (e.g. boot probes with params={}) publish without
+        # slabs; decode falls back to the per-head path until a full tree
+        # lands.
+        build = (self.cfg.engine.fused_task_heads
+                 and all(n in tree for n in SERVING_HEAD_MODULES))
+        slabs = self._build_head_slabs(tree) if build else None
+        self._served = (tree, slabs)
+
+    @property
+    def head_slabs(self):
+        """Device-resident fused decode-head slabs (models/heads.py:
+        build_head_slabs over the served tree; int8 kernel slabs when the
+        storage mode is quantized). None when fused_task_heads is off."""
+        return self._served[1]
+
+    def _place_params(self, params):
+        """Cast/quantize + device-pin a param tree — the ONE placement
+        path __init__ and load_params share.
+
+        Device-pinning mirrors the reference's one-time ``model.cuda(0)``
+        (worker.py:534-536): without it every jitted forward re-uploads
+        ~1 GB of f32 weights host→TPU (23.7 s/query over the remote-TPU
+        link in round 2). Host trees (checkpoint restores, test fixtures)
+        cast — or int8-quantize — host-side first, so the upload ships the
+        small representation; already-committed device trees (init_params)
+        quantize under jit instead, because an eager quantize's scalar
+        constants would be implicit transfers (the conftest sanitizer).
+        """
+        if self.mesh is not None:
+            return shd.shard_params(params, self.mesh,
+                                    dtype=self.param_dtype)
+        host = any(isinstance(x, np.ndarray)
+                   for x in jax.tree_util.tree_leaves(params))
+        if self.param_quantized and not host:
+            return jax.jit(quant.quantize_tree)(params)
+        return jax.device_put(shd.cast_floating(params, self.param_dtype))
+
+    def _make_head_slab_builder(self):
+        """Jitted head-slab stacker, built once in ``__init__`` (same
+        shapes across swaps — load_params stays zero-recompile for the
+        forward programs and pays only this tiny stacking program). In
+        int8 mode the wide kernel slabs are re-quantized after stacking so
+        slab HBM reads stay int8 too; LN scales and biases stay floating —
+        they are a rounding error of the byte budget and
+        precision-critical.
+        """
+        mcfg = self.cfg.model
+        quantized = self.param_quantized
+
+        def build(tree):
+            heads = {n: tree[n] for n in SERVING_HEAD_MODULES}
+            if quantized:
+                heads = quant.dequantize_tree(heads, jnp.float32)
+            slabs = build_head_slabs(heads, mcfg)
+            if quantized:
+                slabs = {k: (quant.quantize_leaf(v)
+                             if k.endswith("kernel") else v)
+                         for k, v in slabs.items()}
+            return slabs
+
+        return jax.jit(build)
+
+    def _build_head_slabs(self, params):
+        """Stack the nine task heads into the fused slab tree, on device
+        (:meth:`_make_head_slab_builder`'s compiled program)."""
+        slabs = self._head_slab_builder(params)
+        jax.block_until_ready(slabs)
+        return slabs
 
     # ------------------------------------------------------------------ init
     def _check_vocab_coherence(self) -> None:
@@ -343,7 +438,11 @@ class InferenceEngine:
                 use_pallas_self_attention=False),
             dtype=self.compute_dtype)
 
-        pdt = self.param_dtype
+        # int8 trees quantize at the placement seam (_place_params) — the
+        # init jit itself keeps f32 leaves.
+        pdt = (self.param_dtype
+               if jnp.issubdtype(self.param_dtype, jnp.floating)
+               else jnp.dtype(jnp.float32))
 
         def _init(rng):
             variables = init_model.init(
@@ -364,17 +463,15 @@ class InferenceEngine:
 
         The compiled programs take params as a call argument, not a
         closure (``fwd(params, ...)``), so a same-shape tree swaps in with
-        ZERO recompiles: placement/cast mirrors ``__init__`` (shard under
-        a mesh, cast + device-pin otherwise) and the attribute assignment
-        is atomic — an in-flight forward finishes against the tree it
-        started with, the next dispatch reads the new one.
+        ZERO recompiles: placement/cast mirrors ``__init__``
+        (:meth:`_place_params` — shard under a mesh, cast/quantize +
+        device-pin otherwise, so an int8 engine RE-QUANTIZES a swapped f32
+        checkpoint instead of silently serving it fat) and the publish is
+        one attribute write of the (params, head_slabs) pair — an
+        in-flight forward finishes against the pair it started with, the
+        next dispatch reads the new one.
         """
-        if self.mesh is not None:
-            params = shd.shard_params(params, self.mesh,
-                                      dtype=self.param_dtype)
-        else:
-            params = jax.device_put(
-                shd.cast_floating(params, self.param_dtype))
+        params = self._place_params(params)
         # Block BEFORE publishing: a half-uploaded tree must never be
         # observable, and the swap caller's timing should measure the
         # upload, not leak it into the next request's forward.
@@ -414,28 +511,84 @@ class InferenceEngine:
                if out.vil_binary_prediction is not None else {}),
         }
 
+    @classmethod
+    def _fused_bundle(cls, out: ViLBertOutput, label_logits, task_ids,
+                      gqa_gather):
+        """Decode bundle for the fused-head program: ONE f32 softmax/top-k
+        over the label head GATHERED per row by task id (the in-program
+        gather — stacked label logits never leave the device), written
+        under BOTH label keys so :meth:`decode` stays family-agnostic.
+        Padded label columns sit at heads.PAD_LOGIT_BIAS and underflow to
+        probability zero, so top-k matches the per-head softmax."""
+        f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+        table = jnp.asarray(gqa_gather, jnp.int32)
+        sel = table[jnp.clip(task_ids[:, 0], 0, table.shape[0] - 1)]
+        row = jnp.take_along_axis(
+            f32(label_logits), sel[:, None, None], axis=1)[:, 0]
+        pair = jax.lax.top_k(jax.nn.softmax(row, axis=-1), cls._TOPK)
+        return {
+            "labels_top": {"vil_prediction": pair,
+                           "vil_prediction_gqa": pair},
+            "vil_logit": f32(out.vil_logit),
+            "vil_tri_prediction": f32(out.vil_tri_prediction),
+            "vision_logit": f32(out.vision_logit),
+            **({"vil_binary_prediction": f32(out.vil_binary_prediction)}
+               if out.vil_binary_prediction is not None else {}),
+        }
+
+    def _apply_heads(self, model, params, heads, batch, attn):
+        """Shared trace body of the two forward builders: in-program int8
+        dequant → trunk or full module apply → per-head or fused-slab
+        heads → device-side decode bundle. Runs under jit only."""
+        cdt = self.compute_dtype
+        if self.param_quantized:
+            # The fused values.astype(compute) * scales sits right before
+            # each consuming matmul after XLA fusion — weight HBM reads
+            # stay int8; only the trainer ever holds fat masters.
+            params = quant.dequantize_tree(params, cdt)
+        if heads is not None:
+            trunk_out = model.apply(
+                {"params": params},
+                batch["input_ids"], batch["features"], batch["spatials"],
+                batch["segment_ids"], batch["input_mask"],
+                batch["image_mask"], None, batch["task_ids"],
+                deterministic=True, output_all_attention_masks=attn,
+                method="trunk",
+            )
+            slabs = (quant.dequantize_tree(heads, jnp.float32)
+                     if self.param_quantized else heads)
+            out, label_logits = fused_head_output(
+                model.config, slabs, trunk_out, batch["image_mask"], cdt)
+            bundle = self._fused_bundle(out, label_logits,
+                                        batch["task_ids"], self._gqa_gather)
+            return out, bundle
+        out = model.apply(
+            {"params": params},
+            batch["input_ids"], batch["features"], batch["spatials"],
+            batch["segment_ids"], batch["input_mask"],
+            batch["image_mask"], None, batch["task_ids"],
+            deterministic=True, output_all_attention_masks=attn,
+            # serving decodes never read the masked-LM/region heads
+            compute_pretraining_heads=False,
+        )
+        return out, InferenceEngine._decode_bundle(out)
+
     def _forward(self, bucket: int, collect_attention: bool):
         """Batched-input program (the mesh path: inputs are device_put with
-        batch shardings as one (bucket, ...) tree per call)."""
+        batch shardings as one (bucket, ...) tree per call). Signature is
+        ``fwd(params, heads, batch)`` — ``heads`` is the persistent fused
+        head-slab tree (None when fused_task_heads is off)."""
         key = ("batched", bucket, collect_attention, self._model_gen)
         with self._compile_lock:
             if key in self._compiled:
                 return self._compiled[key]
             _COMPILES.inc(program="batched")
             model = self.model
+            engine = self
 
             @partial(jax.jit, static_argnames=("attn",))
-            def fwd(params, batch, attn=collect_attention):
-                out = model.apply(
-                    {"params": params},
-                    batch["input_ids"], batch["features"], batch["spatials"],
-                    batch["segment_ids"], batch["input_mask"],
-                    batch["image_mask"], None, batch["task_ids"],
-                    deterministic=True, output_all_attention_masks=attn,
-                    # serving decodes never read the masked-LM/region heads
-                    compute_pretraining_heads=False,
-                )
-                return out, InferenceEngine._decode_bundle(out)
+            def fwd(params, heads, batch, attn=collect_attention):
+                return engine._apply_heads(model, params, heads, batch, attn)
 
             self._compiled[key] = fwd
             return fwd
@@ -460,23 +613,24 @@ class InferenceEngine:
                 return self._compiled[key]
             _COMPILES.inc(program="rows")
             model = self.model
+            engine = self
             donate = (("pack",)
                       if jax.default_backend() in ("tpu", "gpu") else ())
 
             @partial(jax.jit, static_argnames=("attn",),
                      donate_argnames=donate)
-            def fwd(params, slab, pack, attn=collect_attention):
+            def fwd(params, heads, slab, pack, attn=collect_attention):
                 rows = pack["rows"]
-                out = model.apply(
-                    {"params": params},
-                    pack["input_ids"], slab["features"][rows],
-                    slab["spatials"][rows],
-                    pack["segment_ids"], pack["input_mask"],
-                    slab["image_mask"][rows], None, pack["task_ids"],
-                    deterministic=True, output_all_attention_masks=attn,
-                    compute_pretraining_heads=False,
+                batch = dict(
+                    input_ids=pack["input_ids"],
+                    features=slab["features"][rows],
+                    spatials=slab["spatials"][rows],
+                    segment_ids=pack["segment_ids"],
+                    input_mask=pack["input_mask"],
+                    image_mask=slab["image_mask"][rows],
+                    task_ids=pack["task_ids"],
                 )
-                return out, InferenceEngine._decode_bundle(out)
+                return engine._apply_heads(model, params, heads, batch, attn)
 
             self._compiled[key] = fwd
             return fwd
@@ -563,8 +717,12 @@ class InferenceEngine:
         """
         builder = self._forward_rows if rows else self._forward
         gen_before = self._model_gen
+        # One atomic read of the (params, head_slabs) pair: a concurrent
+        # load_params can never hand this dispatch a new tree with the old
+        # tree's fused head slabs.
+        params, heads = self._served
         try:
-            return builder(bucket, collect_attention)(self.params, *args)
+            return builder(bucket, collect_attention)(params, heads, *args)
         except Exception as e:  # noqa: BLE001 — compile-time rejection
             with self._fallback_lock:
                 # Parallel warmup: several buckets can hit the rejection at
@@ -578,7 +736,7 @@ class InferenceEngine:
                 # error; re-running the forward would double device work
                 # exactly when the device is struggling.
                 raise
-            return builder(bucket, collect_attention)(self.params, *args)
+            return builder(bucket, collect_attention)(params, heads, *args)
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                parallel: Optional[bool] = None) -> None:

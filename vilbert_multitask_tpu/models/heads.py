@@ -18,11 +18,18 @@ Head topologies follow the 12-in-1 model family:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from vilbert_multitask_tpu.config import ViLBertConfig
 from vilbert_multitask_tpu.models.layers import ACT
+
+# Logit floor written into the padded dense2 bias columns of the stacked
+# label slab (build_head_slabs): padded columns come out at exactly this
+# value, which underflows to probability 0 in the f32 softmax — so top-k
+# over the padded width matches top-k over each head's real width.
+PAD_LOGIT_BIAS = -1e9
 
 
 class Pooler(nn.Module):
@@ -52,6 +59,89 @@ class SimpleClassifier(nn.Module):
         h = ACT[self.activation](h)
         h = nn.LayerNorm(epsilon=self.layer_norm_eps, dtype=self.dtype, name="norm")(h)
         return nn.Dense(self.out_dim, dtype=self.dtype, name="dense2")(h)
+
+
+# Param-tree module names of the heads the fused decode program consumes
+# (ViLBertForVLTasks.setup) — the slab builder's input contract.
+SERVING_HEAD_MODULES = (
+    "vil_prediction", "vil_prediction_gqa", "vil_binary_prediction",
+    "vil_logit", "vil_tri_prediction", "vision_logit", "linguisic_logit",
+)
+
+
+def build_head_slabs(head_params, cfg: ViLBertConfig) -> dict:
+    """Stack the nine serving heads' weights into batched slabs — the
+    weights side of the fused decode-head program (models/vilbert.py:
+    fused_head_output).
+
+    - the two wide label classifiers (VQA / GQA) stack on a leading head
+      axis; their dense2 kernels zero-pad to the wider label count and the
+      padded bias columns carry :data:`PAD_LOGIT_BIAS` so padded logits
+      drop out of the softmax;
+    - the two tiny pooled heads (vil_logit, vil_tri_prediction) concat
+      into one (bi, 4) kernel — independent output columns, so slicing
+      the fused product reproduces each head exactly;
+    - the paired NLVR2 classifier and the per-token grounding heads keep
+      their own leaves (different input shapes; nothing to batch).
+
+    Pure stacking math over a head-params subtree (``params[name]`` for
+    each name in :data:`SERVING_HEAD_MODULES`) — jit it over the served
+    tree to build the slabs on device.
+    """
+    vqa = head_params["vil_prediction"]
+    gqa = head_params["vil_prediction_gqa"]
+    binary = head_params["vil_binary_prediction"]
+    wmax = max(cfg.num_labels, cfg.gqa_num_labels)
+
+    def padded(head):
+        k, b = head["dense2"]["kernel"], head["dense2"]["bias"]
+        pad = wmax - b.shape[-1]
+        return (jnp.pad(k, ((0, 0), (0, pad))),
+                jnp.pad(b, (0, pad), constant_values=PAD_LOGIT_BIAS))
+
+    k_vqa, b_vqa = padded(vqa)
+    k_gqa, b_gqa = padded(gqa)
+    return {
+        "label_d1_kernel": jnp.stack(
+            [vqa["dense1"]["kernel"], gqa["dense1"]["kernel"]]),
+        "label_d1_bias": jnp.stack(
+            [vqa["dense1"]["bias"], gqa["dense1"]["bias"]]),
+        "label_ln_scale": jnp.stack(
+            [vqa["norm"]["scale"], gqa["norm"]["scale"]]),
+        "label_ln_bias": jnp.stack(
+            [vqa["norm"]["bias"], gqa["norm"]["bias"]]),
+        "label_d2_kernel": jnp.stack([k_vqa, k_gqa]),
+        "label_d2_bias": jnp.stack([b_vqa, b_gqa]),
+        "pooled_kernel": jnp.concatenate(
+            [head_params["vil_logit"]["kernel"],
+             head_params["vil_tri_prediction"]["kernel"]], axis=-1),
+        "pooled_bias": jnp.concatenate(
+            [head_params["vil_logit"]["bias"],
+             head_params["vil_tri_prediction"]["bias"]], axis=-1),
+        "binary_d1_kernel": binary["dense1"]["kernel"],
+        "binary_d1_bias": binary["dense1"]["bias"],
+        "binary_ln_scale": binary["norm"]["scale"],
+        "binary_ln_bias": binary["norm"]["bias"],
+        "binary_d2_kernel": binary["dense2"]["kernel"],
+        "binary_d2_bias": binary["dense2"]["bias"],
+        "vision_kernel": head_params["vision_logit"]["kernel"],
+        "vision_bias": head_params["vision_logit"]["bias"],
+        "ling_kernel": head_params["linguisic_logit"]["kernel"],
+        "ling_bias": head_params["linguisic_logit"]["bias"],
+    }
+
+
+def fused_layer_norm(h, scale, bias, eps: float):
+    """LayerNorm with flax ``nn.LayerNorm`` numerics: statistics in f32
+    (``var = max(0, E[x²] − E[x]²)``), scale folded into the rsqrt, result
+    cast back to the input dtype — so the fused classifier matches the
+    per-head module path to f32 rounding."""
+    dt = h.dtype
+    x = h.astype(jnp.float32)
+    mean = x.mean(axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, (x * x).mean(axis=-1, keepdims=True) - mean * mean)
+    mul = jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    return ((x - mean) * mul + bias.astype(jnp.float32)).astype(dt)
 
 
 class TextPredictionHead(nn.Module):

@@ -26,7 +26,9 @@ from vilbert_multitask_tpu.models.heads import (
     Pooler,
     SimpleClassifier,
     TextPredictionHead,
+    fused_layer_norm,
 )
+from vilbert_multitask_tpu.models.layers import ACT
 from vilbert_multitask_tpu.ops.attention import mask_to_bias
 
 
@@ -148,6 +150,33 @@ class ViLBertForVLTasks(nn.Module):
         self.cls_image = ImagePredictionHead(cfg, dtype=self.dtype)
         self.head_dropout = nn.Dropout(0.1)
 
+    def trunk(
+        self,
+        input_ids,
+        features,
+        spatials,
+        segment_ids,
+        input_mask,
+        image_mask,
+        co_attention_mask=None,  # accepted for contract parity; zeros in serving
+        task_ids=None,
+        *,
+        deterministic: bool = True,
+        output_all_attention_masks: bool = False,
+    ):
+        """Trunk-only apply target (``model.apply(..., method="trunk")``)
+        for the engine's fused-head serving path: same positional contract
+        as :meth:`__call__`, but stops at the pooled vectors — the nine
+        heads run as ONE batched slab program outside the module (see
+        :func:`fused_head_output`), so mixed-task chunks stop paying nine
+        sequential small matmuls."""
+        return self.bert(
+            input_ids, features, spatials, segment_ids, input_mask,
+            image_mask, task_ids,
+            deterministic=deterministic,
+            collect_attention=output_all_attention_masks,
+        )
+
     def __call__(
         self,
         input_ids,
@@ -228,3 +257,79 @@ class ViLBertForVLTasks(nn.Module):
             linguisic_logit=linguisic_logit,
             attn_data_list=attn_maps,
         )
+
+
+def fused_head_output(
+    cfg: ViLBertConfig, slabs: dict, trunk_out, image_mask, dtype
+) -> Tuple[ViLBertOutput, jnp.ndarray]:
+    """All nine serving heads from one trunk pass, as batched slab matmuls.
+
+    ``slabs`` is :func:`..models.heads.build_head_slabs` over the served
+    tree (already dequantized when params are int8); ``trunk_out`` is the
+    :meth:`ViLBertForVLTasks.trunk` 6-tuple. Reproduces the per-head
+    ``__call__`` numerics (flax casts every kernel/bias to the compute
+    dtype; LayerNorm statistics in f32 — :func:`fused_layer_norm`), so the
+    returned :class:`ViLBertOutput` matches the module path to rounding:
+    the stacked label logits slice back to each head's real width, the
+    concat-fused pooled heads have independent output columns, and head
+    dropout is a serving no-op (deterministic).
+
+    Also returns the raw stacked ``(B, 2, max_label_width)`` label logits —
+    the engine's decode bundle gathers per-row by task id from them (ONE
+    softmax/top-k instead of two full-width passes); padded columns sit at
+    ``PAD_LOGIT_BIAS`` and vanish in the softmax.
+    """
+    t_seq, v_seq, pooled_t, pooled_v, attn_maps, _ = trunk_out
+    if cfg.fusion_method == "mul":
+        pooled = pooled_t * pooled_v
+    elif cfg.fusion_method == "sum":
+        pooled = pooled_t + pooled_v
+    else:
+        raise ValueError(f"unknown fusion_method {cfg.fusion_method}")
+    k = lambda name: slabs[name].astype(dtype)  # noqa: E731
+
+    # Wide label pair (VQA + GQA): one batched classifier over a head axis.
+    h = jnp.einsum("bi,kio->bko", pooled, k("label_d1_kernel"))
+    h = ACT["gelu"](h + k("label_d1_bias")[None])
+    h = fused_layer_norm(h, slabs["label_ln_scale"], slabs["label_ln_bias"],
+                         cfg.layer_norm_eps)
+    label_logits = (jnp.einsum("bko,kow->bkw", h, k("label_d2_kernel"))
+                    + k("label_d2_bias")[None])
+    vil_prediction = label_logits[:, 0, : cfg.num_labels]
+    vil_prediction_gqa = label_logits[:, 1, : cfg.gqa_num_labels]
+
+    # Tiny pooled heads, concat-fused: columns 0 = vil_logit, 1:4 = tri.
+    small = pooled @ k("pooled_kernel") + k("pooled_bias")
+    vil_logit = small[:, :1]
+    vil_tri_prediction = small[:, 1:4]
+
+    # NLVR2 paired head: even batches only (models/vilbert.py pairing).
+    vil_binary_prediction = None
+    if pooled.shape[0] % 2 == 0:
+        paired = pooled.reshape(pooled.shape[0] // 2, -1)
+        hb = ACT["gelu"](paired @ k("binary_d1_kernel")
+                         + k("binary_d1_bias"))
+        hb = fused_layer_norm(hb, slabs["binary_ln_scale"],
+                              slabs["binary_ln_bias"], cfg.layer_norm_eps)
+        vil_binary_prediction = (hb @ k("binary_d2_kernel")
+                                 + k("binary_d2_bias"))
+
+    # Per-token grounding heads, mask penalty folded in as in __call__.
+    vision_logit = v_seq @ k("vision_kernel") + k("vision_bias")
+    vision_logit = vision_logit + mask_to_bias(
+        image_mask, dtype)[:, 0, 0, :, None]
+    linguisic_logit = t_seq @ k("ling_kernel") + k("ling_bias")
+
+    out = ViLBertOutput(
+        vil_prediction=vil_prediction,
+        vil_prediction_gqa=vil_prediction_gqa,
+        vil_logit=vil_logit,
+        vil_binary_prediction=vil_binary_prediction,
+        vil_tri_prediction=vil_tri_prediction,
+        vision_prediction=None,
+        vision_logit=vision_logit,
+        linguisic_prediction=None,
+        linguisic_logit=linguisic_logit,
+        attn_data_list=attn_maps,
+    )
+    return out, label_logits

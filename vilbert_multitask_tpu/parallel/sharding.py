@@ -24,6 +24,8 @@ from typing import Any, List, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from vilbert_multitask_tpu import quant
+
 # (regex over "/"-joined param path, spec). First match wins; paths end with
 # the leaf name (kernel/bias/embedding/scale/...).
 _RULES: List[Tuple[str, P]] = [
@@ -70,6 +72,12 @@ def param_specs(params: Any, mesh: Mesh) -> Any:
 
     def spec_for(path, leaf):
         p = _path_str(path)
+        # int8 param storage (quant.py) nests each kernel one level deeper
+        # as {"int8": values, "scale": scales}: the values keep the kernel's
+        # shape, so the kernel's own rule applies — strip the suffix. The
+        # per-channel scale vectors fall through to the default (replicated).
+        if p.endswith("/" + quant.QVALUES):
+            p = p[: -len("/" + quant.QVALUES)]
         for pattern, spec in _RULES:
             if re.match(pattern, p):
                 if len(spec) > leaf.ndim or not _spec_fits(spec, leaf.shape, mesh):
@@ -92,22 +100,34 @@ def cast_floating(params: Any, dtype) -> Any:
 
     The serving param-storage cast (EngineConfig.param_dtype): applied
     host-side before the boot upload when possible — a bf16 serving tree
-    ships half the bytes of its f32 master — and shape-preserving, so
-    sharding rules and checkpoint trees are unaffected. ``dtype=None`` is
-    the identity (the training path: f32 masters are never cast here).
+    ships half the bytes of its f32 master. ``dtype="int8"`` is the
+    weight-only quantized storage mode: floating matrix leaves become
+    per-channel ``{"int8", "scale"}`` pairs (quant.py) instead of being
+    value-cast; already-quantized pairs pass through untouched, so the
+    restore -> ``load_params`` double cast and the /admin/swap
+    re-quantization path are both idempotent. ``dtype=None`` is the
+    identity (the training path: f32 masters are never cast here).
     """
     if dtype is None:
         return params
     import jax.numpy as jnp
 
     dt = jnp.dtype(dtype)
+    if dt.kind in "iu":
+        if dt != jnp.dtype(jnp.int8):
+            raise ValueError(
+                f"integer param storage supports int8 only, got {dt}")
+        return quant.quantize_tree(params)
 
     def one(x):
+        if quant.is_quantized_leaf(x):
+            return x
         if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
             return x.astype(dt)
         return x
 
-    return jax.tree_util.tree_map(one, params)
+    return jax.tree_util.tree_map(one, params,
+                                  is_leaf=quant.is_quantized_leaf)
 
 
 def shard_params(params: Any, mesh: Mesh, *, dtype=None) -> Any:

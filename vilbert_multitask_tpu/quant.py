@@ -1,0 +1,136 @@
+"""Weight-only int8 quantization for the serving param store.
+
+``EngineConfig.param_dtype="int8"`` halves weight bytes AGAIN over bf16
+(PR 4): every floating matrix leaf is stored as a per-channel symmetric
+``{"int8": values, "scale": f32}`` pair and dequantized *inside* the jitted
+forward (engine/runtime.py), immediately before the matmul that consumes
+it — HBM reads stay int8, the MXU sees bf16/f32. The trainer never sees
+this module: f32 masters stay f32; quantization happens only at the
+serving cast seam (parallel/sharding.py:cast_floating).
+
+Scheme — per-channel (last-axis) symmetric:
+
+- ``scale[c] = max(|x[..., c]|) / 127`` over all non-last axes (a zero
+  column gets scale 1.0 so the divide is safe and round-trips to zeros);
+- ``q = clip(round(x / scale), -127, 127).astype(int8)`` — same shape as
+  the source leaf, so sharding rules keyed on the path still fit;
+- dequant: ``q.astype(compute_dtype) * scale.astype(compute_dtype)``.
+
+Only leaves with ``ndim >= 2`` are quantized (kernels, embedding tables).
+Vectors — biases, LayerNorm scales — stay floating: they are a rounding
+error of the byte budget and per-channel scales would degenerate to
+per-element there.
+
+A quantized pair is a plain dict, so the tree stays an ordinary pytree:
+Orbax round-trips it, ``jax.device_put`` places it, and
+``engine/flops.py:param_tree_bytes`` sums the int8 values + f32 scales
+with no special casing — the roofline is dtype-aware for free.
+
+Host/device duality: numpy leaves are quantized with numpy ops (the
+checkpoint-restore and boot paths stay host-side — no device transfer
+before placement), jax arrays/tracers with jnp ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+QVALUES = "int8"
+QSCALE = "scale"
+
+_QKEYS = frozenset((QVALUES, QSCALE))
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    """True for one ``{"int8": values, "scale": scales}`` pair."""
+    return isinstance(x, dict) and set(x.keys()) == _QKEYS
+
+
+def tree_is_quantized(params: Any) -> bool:
+    """True when the tree holds at least one quantized pair (the served
+    storage mode is int8). Cheap: walks the python structure, not data."""
+    import jax
+
+    found = False
+
+    # Probe with pairs as leaves: matching on PAIR STRUCTURE, not leaf
+    # names — "scale" is also every LayerNorm leaf's name, so a name probe
+    # would misreport any unquantized flax tree as quantized.
+    def probe(leaf):
+        nonlocal found
+        found = found or is_quantized_leaf(leaf)
+        return leaf
+
+    jax.tree_util.tree_map(probe, params, is_leaf=is_quantized_leaf)
+    return found
+
+
+def quantize_leaf(x: Any) -> dict:
+    """One floating leaf (ndim >= 2) -> ``{"int8": q, "scale": s}``.
+
+    ``q`` keeps the leaf's shape; ``s`` is f32 of shape ``(last_dim,)``.
+    Numpy in, numpy out (host path); jax in, jax out (tracer/device path).
+    """
+    if isinstance(x, np.ndarray):
+        xp = np
+        # Unreachable under tracing (a tracer is never np.ndarray) — this
+        # branch is the host path only.
+        xf = np.asarray(x, np.float32)  # vmtlint: disable=VMT101
+    else:
+        import jax.numpy as jnp
+
+        xp = jnp
+        xf = x.astype(jnp.float32)
+    axes = tuple(range(xf.ndim - 1))
+    amax = xp.max(xp.abs(xf), axis=axes)
+    scale = xp.where(amax == 0.0, xp.ones_like(amax), amax / 127.0)
+    scale = scale.astype(np.float32 if xp is np else xp.float32)
+    q = xp.clip(xp.round(xf / scale), -127, 127).astype(np.int8)
+    return {QVALUES: q, QSCALE: scale}
+
+
+def dequantize_leaf(pair: dict, dtype) -> Any:
+    """``{"int8", "scale"}`` -> dense array in ``dtype``. Runs inside the
+    jitted forward (fused with the consuming matmul by XLA); calling it on
+    host arrays outside jit re-inflates HBM traffic — vmtlint VMT118."""
+    q, s = pair[QVALUES], pair[QSCALE]
+    return q.astype(dtype) * s.astype(dtype)
+
+
+def quantize_tree(params: Any) -> Any:
+    """Quantize every floating ``ndim >= 2`` leaf; idempotent — already
+    quantized pairs pass through untouched, so the checkpoint-restore ->
+    ``load_params`` double cast is safe."""
+    import jax
+
+    def one(x):
+        if is_quantized_leaf(x):
+            return x
+        dt = np.dtype(x.dtype)
+        if dt.kind == "f" and getattr(x, "ndim", 0) >= 2:
+            return quantize_leaf(x)
+        return x
+
+    return jax.tree_util.tree_map(one, params, is_leaf=is_quantized_leaf)
+
+
+def dequantize_tree(params: Any, dtype) -> Any:
+    """Expand every quantized pair back to a dense ``dtype`` array and cast
+    the remaining floating leaves to match — the in-jit view the forward
+    computes with. Non-quantized trees pass through (modulo the cast), so
+    one code path serves both storage modes."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+
+    def one(x):
+        if is_quantized_leaf(x):
+            return dequantize_leaf(x, dt)
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+            return x.astype(dt)
+        return x
+
+    return jax.tree_util.tree_map(one, params, is_leaf=is_quantized_leaf)

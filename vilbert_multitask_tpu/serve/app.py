@@ -73,8 +73,9 @@ class ServeApp:
                 from vilbert_multitask_tpu.checkpoint import restore_params
 
                 # Serving restore casts to the engine's param-storage dtype
-                # host-side (bf16 mode ships half the checkpoint bytes);
-                # the on-disk checkpoint stays the f32 master.
+                # host-side (bf16 ships half the checkpoint bytes; "int8"
+                # quantizes to per-channel pairs, ~¼ of f32); the on-disk
+                # checkpoint stays the f32 master.
                 params = restore_params(checkpoint_path, mesh=mesh,
                                         dtype=self.cfg.engine.param_dtype)
             store = FeatureStore(feature_root)
@@ -270,7 +271,10 @@ class ServeApp:
         replica stays ready throughout (n >= 2), and since HTTP ingest only
         enqueues, no request observes the swap at all. Same-shape trees
         swap with ZERO recompiles (compiled programs take params as a call
-        argument — engine.load_params)."""
+        argument — engine.load_params). The restore casts to the engine's
+        param_dtype, so an int8 deployment re-quantizes the incoming f32
+        checkpoint here — swapped replicas serve the same storage mode they
+        booted with, never a silently-widened tree."""
         if params is None:
             if checkpoint_path is None:
                 raise ValueError("rolling_swap needs checkpoint_path or "

@@ -420,7 +420,9 @@ class ReplicaPool:
         (``draining``), wait out the in-flight dispatch, load
         (``warming``), flip back to ``ready``.  ``load_fn(engine)`` does
         the actual load — typically ``engine.load_params(new_tree)``,
-        which is recompile-free for same-shape trees.
+        which is recompile-free for same-shape trees and re-applies the
+        engine's param-storage cast (an int8 engine re-quantizes an
+        incoming f32 tree; an already-quantized tree passes through).
         """
         if drain_timeout_s is None:
             drain_timeout_s = self._serving.pool_swap_drain_timeout_s

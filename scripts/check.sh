@@ -13,10 +13,18 @@ cd "$(dirname "$0")/.."
 
 fail=0
 
-echo "== vmtlint (whole-program, strict) =="
+echo "== vmtlint (strict, changed-closure scan; VMT_FULL=1 for whole repo) =="
 # --strict: warnings gate too, and stale baseline entries fail — debt
 # that got paid must leave vmtlint_baseline.json (use --prune-baseline).
-python -m vilbert_multitask_tpu.analysis --strict --format json || fail=1
+# Default is --changed: the diff vs HEAD plus its import closure, which
+# falls back to a full scan by itself when the closure is most of the
+# project. VMT_FULL=1 forces the whole-repo scan (CI, pre-merge).
+if [[ "${VMT_FULL:-}" == "1" ]]; then
+  python -m vilbert_multitask_tpu.analysis --strict --format json || fail=1
+else
+  python -m vilbert_multitask_tpu.analysis --strict --format json --changed \
+    || fail=1
+fi
 
 if [[ "${1:-}" == "--lint" ]]; then
   exit "$fail"

@@ -89,7 +89,8 @@ def test_whole_program_rules_active_and_scan_covers_tests():
     # "whole repo is race/layer clean" guarantee quietly narrows.
     cfg, _root = load_config(REPO_ROOT)
     ids = {r.id for r in default_rules()}
-    assert {"VMT110", "VMT111", "VMT112"} <= ids
+    assert {"VMT110", "VMT111", "VMT112",
+            "VMT119", "VMT120", "VMT121", "VMT122"} <= ids
     assert cfg.layers, "[tool.vmtlint.layers] contracts disappeared"
     assert any(p == "tests" or p.startswith("tests/") for p in cfg.paths)
 

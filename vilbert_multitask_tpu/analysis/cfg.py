@@ -1,0 +1,369 @@
+"""Per-function control-flow graphs for the dataflow tier.
+
+The builder lowers one ``ast.FunctionDef`` / ``ast.AsyncFunctionDef`` into a
+graph of basic blocks.  Each block carries an ordered list of *events*:
+
+* plain statements and branch/loop test expressions (``ast.AST`` nodes), and
+* ``WithEnter`` / ``WithExit`` markers, one pair per ``withitem``.
+
+``with`` scopes are the part that matters for the lock-set domain, so the
+builder is careful about release edges: a ``return`` / ``break`` / ``continue``
+/ ``raise`` inside a ``with`` body emits the ``WithExit`` markers for every
+frame it unwinds *before* the jump edge, which is exactly what CPython's
+``__exit__`` protocol guarantees at runtime.
+
+``try`` statements are modelled conservatively: exception edges run from each
+top-level statement boundary of the ``try`` body to every handler entry (state
+*after* a completed statement — by which point any ``with`` opened and closed
+inside that statement has already released), handler and body exits funnel
+through the ``finally`` blocks when present, and the ``finally`` chain feeds
+the join block after the statement.
+
+Blocks that end up with no predecessors (code after a ``return``, an empty
+branch arm, ...) simply stay unreachable; the worklist solver in
+``analysis.dataflow`` never visits them.
+
+Everything here is stdlib-only — the layering contract forbids the analysis
+package from importing jax or numpy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Union
+
+
+class WithEnter:
+    """Marker event: the context manager of ``item`` has been entered."""
+
+    __slots__ = ("item", "stmt")
+
+    def __init__(self, item: ast.withitem, stmt: ast.AST) -> None:
+        self.item = item
+        self.stmt = stmt
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"WithEnter@{getattr(self.item.context_expr, 'lineno', '?')}"
+
+
+class WithExit:
+    """Marker event: the context manager of ``item`` has been exited."""
+
+    __slots__ = ("item", "stmt")
+
+    def __init__(self, item: ast.withitem, stmt: ast.AST) -> None:
+        self.item = item
+        self.stmt = stmt
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"WithExit@{getattr(self.item.context_expr, 'lineno', '?')}"
+
+
+Event = Union[ast.AST, WithEnter, WithExit]
+
+
+class Block:
+    """A basic block: an ordered event list plus successor edges."""
+
+    __slots__ = ("id", "events", "succs")
+
+    def __init__(self, bid: int) -> None:
+        self.id = bid
+        self.events: List[Event] = []
+        self.succs: List["Block"] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Block({self.id}, events={len(self.events)}, succs={[s.id for s in self.succs]})"
+
+
+class CFG:
+    """Control-flow graph of a single function."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.blocks: List[Block] = []
+        builder = _Builder(self)
+        builder.build(fn)
+        self.entry: Block = builder.entry
+        self.exit: Block = builder.exit
+
+    def preds(self, block: Block) -> List[Block]:
+        return [b for b in self.blocks if block in b.succs]
+
+    def reachable(self) -> List[Block]:
+        """Blocks reachable from the entry, in discovery order."""
+        seen = {self.entry.id}
+        order = [self.entry]
+        stack = [self.entry]
+        while stack:
+            blk = stack.pop()
+            for succ in blk.succs:
+                if succ.id not in seen:
+                    seen.add(succ.id)
+                    order.append(succ)
+                    stack.append(succ)
+        return order
+
+
+class _LoopFrame:
+    __slots__ = ("continue_target", "break_target", "with_depth")
+
+    def __init__(self, continue_target: Block, break_target: Block, with_depth: int) -> None:
+        self.continue_target = continue_target
+        self.break_target = break_target
+        self.with_depth = with_depth
+
+
+class _Builder:
+    def __init__(self, cfg: CFG) -> None:
+        self.cfg = cfg
+        self.entry = self._new_block()
+        self.exit = self._new_block()
+        # Stack of (withitem, stmt) frames currently open, innermost last.
+        self._with_stack: List[tuple] = []
+        self._loop_stack: List[_LoopFrame] = []
+
+    def build(self, fn: ast.AST) -> None:
+        end = self._stmts(fn.body, self.entry)
+        if end is not None:
+            self._edge(end, self.exit)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _new_block(self) -> Block:
+        blk = Block(len(self.cfg.blocks))
+        self.cfg.blocks.append(blk)
+        return blk
+
+    @staticmethod
+    def _edge(src: Block, dst: Block) -> None:
+        if dst not in src.succs:
+            src.succs.append(dst)
+
+    def _unwind_withs(self, block: Block, down_to: int) -> None:
+        """Emit WithExit markers for every frame above ``down_to``."""
+        for item, stmt in reversed(self._with_stack[down_to:]):
+            block.events.append(WithExit(item, stmt))
+
+    # -- statement lowering -----------------------------------------------
+
+    def _stmts(self, body: Sequence[ast.stmt], cur: Optional[Block]) -> Optional[Block]:
+        for stmt in body:
+            if cur is None:
+                # Unreachable code after a jump; keep building so nested
+                # structures exist, but leave the block predecessor-free.
+                cur = self._new_block()
+            cur = self._stmt(stmt, cur)
+        return cur
+
+    def _stmt(self, stmt: ast.stmt, cur: Block) -> Optional[Block]:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, cur)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, cur)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, cur)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, cur)
+        if isinstance(stmt, ast.Return):
+            cur.events.append(stmt)
+            self._unwind_withs(cur, 0)
+            self._edge(cur, self.exit)
+            return None
+        if isinstance(stmt, ast.Raise):
+            cur.events.append(stmt)
+            self._unwind_withs(cur, 0)
+            self._edge(cur, self.exit)
+            return None
+        if isinstance(stmt, ast.Break):
+            frame = self._loop_stack[-1] if self._loop_stack else None
+            cur.events.append(stmt)
+            if frame is not None:
+                self._unwind_withs(cur, frame.with_depth)
+                self._edge(cur, frame.break_target)
+            return None
+        if isinstance(stmt, ast.Continue):
+            frame = self._loop_stack[-1] if self._loop_stack else None
+            cur.events.append(stmt)
+            if frame is not None:
+                self._unwind_withs(cur, frame.with_depth)
+                self._edge(cur, frame.continue_target)
+            return None
+        # Everything else (Assign, Expr, FunctionDef, ClassDef, Import, ...)
+        # is a straight-line event.  Nested function/class bodies are opaque to
+        # the event walker (see iter_event_nodes).
+        cur.events.append(stmt)
+        return cur
+
+    def _if(self, stmt: ast.If, cur: Block) -> Block:
+        cur.events.append(stmt.test)
+        join = self._new_block()
+        then_entry = self._new_block()
+        self._edge(cur, then_entry)
+        then_end = self._stmts(stmt.body, then_entry)
+        if then_end is not None:
+            self._edge(then_end, join)
+        if stmt.orelse:
+            else_entry = self._new_block()
+            self._edge(cur, else_entry)
+            else_end = self._stmts(stmt.orelse, else_entry)
+            if else_end is not None:
+                self._edge(else_end, join)
+        else:
+            self._edge(cur, join)
+        return join
+
+    def _loop(self, stmt: ast.stmt, cur: Block) -> Block:
+        header = self._new_block()
+        self._edge(cur, header)
+        if isinstance(stmt, ast.While):
+            header.events.append(stmt.test)
+        else:  # For / AsyncFor: iterating evaluates the iterable + target bind
+            header.events.append(stmt.iter)
+            header.events.append(stmt.target)
+        after = self._new_block()
+        body_entry = self._new_block()
+        self._edge(header, body_entry)
+        # `while True:` has no false edge; everything else can skip the body.
+        infinite = isinstance(stmt, ast.While) and _is_truthy_const(stmt.test)
+        if not infinite:
+            if getattr(stmt, "orelse", None):
+                # Normal exit runs `else` then falls to `after`; `break`
+                # (edges straight to `after`) skips it.
+                else_entry = self._new_block()
+                self._edge(header, else_entry)
+                else_end = self._stmts(stmt.orelse, else_entry)
+                if else_end is not None:
+                    self._edge(else_end, after)
+            else:
+                self._edge(header, after)
+        self._loop_stack.append(_LoopFrame(header, after, len(self._with_stack)))
+        body_end = self._stmts(stmt.body, body_entry)
+        self._loop_stack.pop()
+        if body_end is not None:
+            self._edge(body_end, header)
+        return after
+
+    def _with(self, stmt: ast.stmt, cur: Block) -> Optional[Block]:
+        for item in stmt.items:
+            cur.events.append(WithEnter(item, stmt))
+            self._with_stack.append((item, stmt))
+        end = self._stmts(stmt.body, cur)
+        frames = [self._with_stack.pop() for _ in stmt.items]
+        if end is not None:
+            for item, owner in frames:
+                end.events.append(WithExit(item, owner))
+        return end
+
+    def _try(self, stmt: ast.Try, cur: Block) -> Block:
+        after = self._new_block()
+        handler_entries = [self._new_block() for _ in stmt.handlers]
+
+        # Exception edges: state observable at a handler is the state at some
+        # top-level statement boundary of the try body (locks opened-and-closed
+        # inside a statement have released by then; an explicit .acquire() in a
+        # completed statement is still held).
+        # Split before the body as well: `cur` must end at the pre-try
+        # boundary or the first statement's events would retroactively
+        # change the state its exception edge carries.
+        boundary_blocks = [cur]
+        body_entry = self._new_block()
+        self._edge(cur, body_entry)
+        body_cur: Optional[Block] = body_entry
+        for sub in stmt.body:
+            if body_cur is None:
+                body_cur = self._new_block()
+            body_cur = self._stmt(sub, body_cur)
+            if body_cur is not None:
+                boundary_blocks.append(body_cur)
+                # Force a block split so each exception edge carries the
+                # state at THIS statement's boundary — straight-line
+                # statements would otherwise share a block and leak the
+                # whole body's effects into the handler.
+                nxt = self._new_block()
+                self._edge(body_cur, nxt)
+                body_cur = nxt
+        for blk in boundary_blocks:
+            for entry in handler_entries:
+                self._edge(blk, entry)
+
+        if stmt.finalbody:
+            fin_entry = self._new_block()
+            fin_end = self._stmts(stmt.finalbody, fin_entry)
+            normal_target = fin_entry
+            if fin_end is not None:
+                self._edge(fin_end, after)
+        else:
+            normal_target = after
+
+        if body_cur is not None:
+            if stmt.orelse:
+                else_end = self._stmts(stmt.orelse, body_cur)
+                if else_end is not None:
+                    self._edge(else_end, normal_target)
+            else:
+                self._edge(body_cur, normal_target)
+
+        for handler, entry in zip(stmt.handlers, handler_entries):
+            if handler.type is not None:
+                entry.events.append(handler.type)
+            handler_end = self._stmts(handler.body, entry)
+            if handler_end is not None:
+                self._edge(handler_end, normal_target)
+
+        return after
+
+
+def _is_truthy_const(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build the CFG of one function definition (sync or async)."""
+    return CFG(fn)
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def iter_event_nodes(event: Event) -> Iterator[ast.AST]:
+    """Walk the AST nodes of one event without descending into nested scopes.
+
+    ``WithEnter``/``WithExit`` yield the nodes of their context expression (a
+    lock acquisition like ``with self._cond:`` lives there).  Plain statements
+    yield themselves and their sub-expressions, but the bodies of nested
+    ``def``/``lambda``/``class`` are opaque — they execute on a different
+    activation, not on this function's control path.
+    """
+    if isinstance(event, (WithEnter, WithExit)):
+        roots: List[ast.AST] = [event.item.context_expr]
+    elif isinstance(event, _SCOPE_NODES):
+        # The definition itself executes here (decorators, defaults), but not
+        # its body.
+        roots = list(getattr(event, "decorator_list", []) or [])
+        args = getattr(event, "args", None)
+        if args is not None:
+            roots.extend(args.defaults)
+            roots.extend(d for d in args.kw_defaults if d is not None)
+        roots.extend(getattr(event, "bases", []) or [])
+        return _walk_many(roots)
+    else:
+        roots = [event]
+    return _walk_many(roots)
+
+
+def _walk_many(roots: List[ast.AST]) -> Iterator[ast.AST]:
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_NODES):
+                # Nested scope: its decorators/defaults still run here.
+                stack.extend(getattr(child, "decorator_list", []) or [])
+                args = getattr(child, "args", None)
+                if args is not None:
+                    stack.extend(args.defaults)
+                    stack.extend(d for d in args.kw_defaults if d is not None)
+                continue
+            stack.append(child)

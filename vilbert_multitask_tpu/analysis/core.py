@@ -24,6 +24,11 @@ class Finding:
     col: int
     message: str
     content: str = ""  # stripped source line — the baseline fingerprint key
+    # Optional witness chains (e.g. the two conflicting acquisition orders
+    # of a VMT119 inversion): each flow is an ordered list of
+    # {"path", "line", "message"} steps, rendered as SARIF codeFlows.
+    # Not part of the fingerprint — chains shift when unrelated code moves.
+    flows: List[List[dict]] = dataclasses.field(default_factory=list)
 
     def fingerprint(self) -> str:
         """Line-number-free identity: surviving a pure line shift must not

@@ -1,0 +1,221 @@
+"""Generic forward dataflow over the CFGs of ``analysis.cfg``.
+
+The solver is a plain worklist fixed point over a join-semilattice.  An
+analysis supplies three things:
+
+* ``initial()`` — the fact at the function entry,
+* ``join(a, b)`` — the least upper bound of two facts (both reachable), and
+* ``transfer(event, fact)`` — the fact after one block event.
+
+``None`` is the implicit ⊤/unreached element: blocks no reachable predecessor
+has produced a fact for are skipped, and ``join`` is never called with
+``None``.  Termination needs the usual conditions — monotone transfer, finite
+chains — which both domains here satisfy (facts are frozensets over the finite
+universe of lock ids / definition sites).
+
+Two concrete domains live here:
+
+* ``LockSetAnalysis`` — *must*-hold lock sets (join = intersection), driven by
+  ``WithEnter``/``WithExit`` markers and explicit ``.acquire()``/``.release()``
+  calls on expressions a resolver maps to canonical lock ids.
+* ``ReachingDefs`` — may-reach definition sites for local names (join =
+  union), used by the flow-sensitive jit-closure rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+from .cfg import CFG, Block, Event, WithEnter, WithExit, iter_event_nodes
+
+
+class ForwardAnalysis:
+    """Interface for a forward dataflow analysis (subclass and override)."""
+
+    def initial(self):
+        raise NotImplementedError
+
+    def join(self, a, b):
+        raise NotImplementedError
+
+    def transfer(self, event: Event, fact):
+        raise NotImplementedError
+
+
+def solve(cfg: CFG, analysis: ForwardAnalysis) -> Dict[int, object]:
+    """Run ``analysis`` to a fixed point; return the IN fact per block id.
+
+    Only blocks reachable from the entry get a fact; unreachable block ids are
+    absent from the result.
+    """
+    in_facts: Dict[int, object] = {cfg.entry.id: analysis.initial()}
+    preds: Dict[int, List[Block]] = {}
+    for blk in cfg.blocks:
+        for succ in blk.succs:
+            preds.setdefault(succ.id, []).append(blk)
+
+    out_cache: Dict[int, object] = {}
+
+    def block_out(blk: Block) -> object:
+        fact = in_facts[blk.id]
+        for event in blk.events:
+            fact = analysis.transfer(event, fact)
+        return fact
+
+    worklist: List[Block] = [cfg.entry]
+    on_list = {cfg.entry.id}
+    while worklist:
+        blk = worklist.pop(0)
+        on_list.discard(blk.id)
+        out = block_out(blk)
+        if blk.id in out_cache and out_cache[blk.id] == out:
+            continue
+        out_cache[blk.id] = out
+        for succ in blk.succs:
+            merged = out
+            if succ.id in in_facts:
+                merged = analysis.join(in_facts[succ.id], out)
+            if succ.id not in in_facts or merged != in_facts[succ.id]:
+                in_facts[succ.id] = merged
+                if succ.id not in on_list:
+                    worklist.append(succ)
+                    on_list.add(succ.id)
+    return in_facts
+
+
+def iter_event_facts(
+    cfg: CFG, analysis: ForwardAnalysis, in_facts: Dict[int, object]
+) -> Iterator[Tuple[Event, object]]:
+    """Yield ``(event, fact-before-event)`` for every reachable block."""
+    for blk in cfg.reachable():
+        if blk.id not in in_facts:
+            continue
+        fact = in_facts[blk.id]
+        for event in blk.events:
+            yield event, fact
+            fact = analysis.transfer(event, fact)
+
+
+# ---------------------------------------------------------------------------
+# Lock-set domain (must-hold: join = intersection)
+# ---------------------------------------------------------------------------
+
+LockSet = FrozenSet[str]
+
+_ACQUIRE_METHODS = ("acquire",)
+_RELEASE_METHODS = ("release",)
+
+
+class LockSetAnalysis(ForwardAnalysis):
+    """Which canonical lock ids are *definitely* held before each event.
+
+    ``resolver`` maps a lock expression (``ast.expr``) to a canonical lock id
+    string, or ``None`` when the expression is not a known lock.  Identity
+    resolution (unifying ``self._compile_lock`` across methods, chasing
+    module-level locks through imports) lives with the caller — typically
+    ``analysis.locks.LockRegistry``.
+    """
+
+    def __init__(self, resolver: Callable[[ast.expr], Optional[str]]) -> None:
+        self.resolver = resolver
+
+    def initial(self) -> LockSet:
+        return frozenset()
+
+    def join(self, a: LockSet, b: LockSet) -> LockSet:
+        return a & b
+
+    def transfer(self, event: Event, fact: LockSet) -> LockSet:
+        if isinstance(event, WithEnter):
+            lock = self.resolver(_strip_acquire_call(event.item.context_expr))
+            if lock is not None:
+                return fact | {lock}
+            return fact
+        if isinstance(event, WithExit):
+            lock = self.resolver(_strip_acquire_call(event.item.context_expr))
+            if lock is not None:
+                return fact - {lock}
+            return fact
+        for node in iter_event_nodes(event):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr in _ACQUIRE_METHODS:
+                lock = self.resolver(node.func.value)
+                if lock is not None:
+                    fact = fact | {lock}
+            elif node.func.attr in _RELEASE_METHODS:
+                lock = self.resolver(node.func.value)
+                if lock is not None:
+                    fact = fact - {lock}
+        return fact
+
+
+def _strip_acquire_call(expr: ast.expr) -> ast.expr:
+    """``with lock.acquire_timeout(...)``-style wrappers: look at the receiver."""
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Attribute):
+            return expr.func.value
+        return expr.func
+    return expr
+
+
+# ---------------------------------------------------------------------------
+# Reaching definitions (may: join = union)
+# ---------------------------------------------------------------------------
+
+# A definition site is (name, line) — line numbers are unique enough within a
+# single function and keep the facts hashable and readable.
+DefSite = Tuple[str, int]
+DefSet = FrozenSet[DefSite]
+
+
+class ReachingDefs(ForwardAnalysis):
+    """Which assignments to ``names`` may reach each event."""
+
+    def __init__(self, names: FrozenSet[str], params_line: int = 0) -> None:
+        self.names = names
+        self.params_line = params_line
+
+    def initial(self) -> DefSet:
+        # Function parameters act as a definition at the entry.
+        return frozenset((n, self.params_line) for n in self.names)
+
+    def join(self, a: DefSet, b: DefSet) -> DefSet:
+        return a | b
+
+    def transfer(self, event: Event, fact: DefSet) -> DefSet:
+        assigned = _assigned_names(event) & self.names
+        if not assigned:
+            return fact
+        line = getattr(event, "lineno", self.params_line)
+        fact = frozenset(d for d in fact if d[0] not in assigned)
+        return fact | frozenset((n, line) for n in assigned)
+
+
+def _assigned_names(event: Event) -> FrozenSet[str]:
+    if isinstance(event, WithEnter):
+        vars_ = event.item.optional_vars
+        return _target_names(vars_) if vars_ is not None else frozenset()
+    if isinstance(event, WithExit):
+        return frozenset()
+    names: set = set()
+    if isinstance(event, ast.Assign):
+        for tgt in event.targets:
+            names |= _target_names(tgt)
+    elif isinstance(event, (ast.AugAssign, ast.AnnAssign)):
+        names |= _target_names(event.target)
+    elif isinstance(event, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        names.add(event.name)
+    elif isinstance(event, (ast.Name, ast.Tuple, ast.List)):
+        # A loop target appended to the loop header by the CFG builder.
+        names |= _target_names(event)
+    return frozenset(names)
+
+
+def _target_names(target: ast.expr) -> FrozenSet[str]:
+    names: set = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+    return frozenset(names)

@@ -276,6 +276,95 @@ class ProjectGraph:
         return axes
 
 
+def import_closure(sources: Dict[str, str], changed: Set[str]) -> Set[str]:
+    """Scan-set closure for ``--changed``: the changed files, every
+    transitive reverse importer (callers whose cross-module findings the
+    change could shift), and the transitive forward imports of the changed
+    files themselves (the definitions — lock identities, config
+    declarations — their analysis needs).
+
+    Deliberately lighter than a full :class:`ProjectGraph`: one throwaway
+    parse per file, imports only.  Unparseable files keep their path in the
+    closure when changed (so VMT000 still fires) but contribute no edges.
+    """
+    name_of: Dict[str, str] = {rel: module_name_for(rel) for rel in sources}
+    known: Set[str] = set(name_of.values())
+
+    def to_project_module(dotted: str) -> Optional[str]:
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            if prefix in known:
+                return prefix
+        return None
+
+    forward: Dict[str, Set[str]] = {n: set() for n in known}
+    reverse: Dict[str, Set[str]] = {n: set() for n in known}
+    for rel, source in sources.items():
+        name = name_of[rel]
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue
+        pkg = name.split(".") if rel.endswith("__init__.py") else \
+            name.split(".")[:-1]
+        targets: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    targets.add(a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    anchor = (pkg[:len(pkg) - (node.level - 1)]
+                              if node.level > 1 else pkg)
+                    base = ".".join(
+                        anchor + (node.module.split(".")
+                                  if node.module else []))
+                else:
+                    base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        targets.add(base)
+                    else:
+                        targets.add(f"{base}.{a.name}" if base else a.name)
+        for dotted in targets:
+            mod = to_project_module(dotted)
+            if mod is not None and mod != name:
+                forward[name].add(mod)
+                reverse[mod].add(name)
+
+    seeds = {name_of[rel] for rel in changed if rel in name_of}
+    closure: Set[str] = set(seeds)
+    frontier = list(seeds)
+    while frontier:  # who (transitively) imports the changed modules
+        for imp in reverse.get(frontier.pop(), ()):
+            if imp not in closure:
+                closure.add(imp)
+                frontier.append(imp)
+    frontier = list(seeds)
+    fwd_seen = set(seeds)
+    while frontier:  # what the changed modules (transitively) import
+        for dep in forward.get(frontier.pop(), ()):
+            if dep not in fwd_seen:
+                fwd_seen.add(dep)
+                closure.add(dep)
+                frontier.append(dep)
+    # Siblings coupled through the changed files' dependencies: a module
+    # that imports the same lock/config definitions can form cross-module
+    # findings (an ABBA half, a knob read) WITH the changed code without
+    # ever importing it — reverse-close over the forward set too.  When
+    # the forward set contains a hub (config, obs) this legitimately
+    # inflates the closure past the fallback threshold, which is the safe
+    # direction: full scan, never a silently incomplete lock graph.
+    frontier = [n for n in fwd_seen if n not in seeds]
+    while frontier:
+        for imp in reverse.get(frontier.pop(), ()):
+            if imp not in closure:
+                closure.add(imp)
+                frontier.append(imp)
+    return {rel for rel, n in name_of.items() if n in closure}
+
+
 def module_mesh_axes(ctx: ModuleContext) -> Set[str]:
     axes: Set[str] = set()
     for node in ast.walk(ctx.tree):

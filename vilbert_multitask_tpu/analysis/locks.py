@@ -1,0 +1,896 @@
+"""Project-wide lock-order analysis and the flow-sensitive rules.
+
+Built on ``analysis/cfg.py`` + ``analysis/dataflow.py``:
+
+1. :class:`LockRegistry` gives every lock **object** in the project a
+   canonical identity — ``module:Class.attr`` for ``self._x = threading.Lock()``
+   fields (so the same field unifies across methods, the same resolution
+   `_ClassLockAnalysis` uses for VMT110), ``module:name`` for module-level
+   locks (chased through imports via the ``ProjectGraph`` symbol tables), and
+   a function-scoped id for locals.  Conditions, queues, events and threads
+   are registered too — they are the receivers of the blocking calls VMT120
+   cares about.
+
+2. Per function, the must-hold lock-set dataflow yields a
+   :class:`FnLockSummary`: every acquisition with the set held *before* it,
+   every blocking call (``Condition.wait``/``queue.get``/``join``/
+   ``Event.wait``) with the set held at it, and every resolvable project call
+   made while at least one lock is held.
+
+3. :class:`LockFlow` composes the summaries through the existing
+   :class:`~.callgraph.CallGraph` into a lock-acquisition-order graph: an
+   edge ``A -> B`` means some path acquires ``B`` while holding ``A`` —
+   directly, or through a chain of calls.  A cycle in that graph is an ABBA
+   deadlock candidate (**VMT119**), reported with one witness chain per
+   conflicting order.  Blocking calls whose held-set contains any lock other
+   than the waited condition's own are **VMT120**.
+
+**VMT121** is the flow-sensitive upgrade of VMT102: reaching-definitions over
+the enclosing function's CFG catch a jitted closure whose captured local has
+more than one definition reaching a call site (the first trace bakes one
+value; paths through the other definition silently reuse the stale constant),
+plus trace-time reads of ``self.*``/module globals that some other method
+rebinds.
+
+Everything is stdlib-only (``ast`` + the local dataflow tier) per the
+layering contracts in pyproject.toml.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from vilbert_multitask_tpu.analysis.cfg import (
+    WithEnter, build_cfg, iter_event_nodes)
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.core import Finding, Rule
+from vilbert_multitask_tpu.analysis.dataflow import (
+    LockSetAnalysis, ReachingDefs, _strip_acquire_call, iter_event_facts,
+    solve)
+
+# Constructors that mint an identity the analysis tracks. "lock" and
+# "condition" participate in held-sets; the rest are blocking-call receivers.
+CTOR_KINDS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock",
+    "threading.Condition": "condition",
+    "queue.Queue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "queue.SimpleQueue": "queue",
+    "threading.Thread": "thread",
+    "threading.Event": "event",
+}
+_HELD_KINDS = ("lock", "condition")
+_INIT_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+_BLOCKING_ATTRS = ("wait", "wait_for", "get", "join")
+
+
+@dataclasses.dataclass
+class LockDecl:
+    lock_id: str
+    kind: str
+    display: str  # short human name, e.g. "ReplicaPool._cond"
+    path: str
+    line: int
+
+
+class LockRegistry:
+    """Canonical identities for every lock-ish object in the project."""
+
+    def __init__(self, project) -> None:
+        self.project = project
+        self.by_id: Dict[str, LockDecl] = {}
+        self.class_locks: Dict[Tuple[str, Tuple[str, ...]],
+                               Dict[str, LockDecl]] = {}
+        self.module_locks: Dict[str, Dict[str, LockDecl]] = {}
+        self.local_locks: Dict[str, Dict[str, LockDecl]] = {}
+        cg = project.callgraph
+        for mod in project.modules.values():
+            self._collect_module(mod, cg)
+
+    def _collect_module(self, mod, cg) -> None:
+        ctx = mod.ctx
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            kind = self._ctor_kind(ctx, value)
+            if kind is None:
+                continue
+            for target in targets:
+                self._register(mod, cg, node, target, kind)
+
+    @staticmethod
+    def _ctor_kind(ctx: ModuleContext, value: ast.AST) -> Optional[str]:
+        # Walk the whole RHS: `self.stop = ev if ev else threading.Event()`
+        # still registers the identity.
+        for n in ast.walk(value):
+            if isinstance(n, ast.Call):
+                kind = CTOR_KINDS.get(ctx.resolve(n.func))
+                if kind is not None:
+                    return kind
+        return None
+
+    def _register(self, mod, cg, assign: ast.AST, target: ast.expr,
+                  kind: str) -> None:
+        ctx = mod.ctx
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            owner = ctx.enclosing_function(assign)
+            fnode = cg.by_node.get(id(owner)) if owner is not None else None
+            if fnode is None or not fnode.cls_scope:
+                return
+            key = (mod.name, fnode.cls_scope)
+            decl = self._mk(
+                f"{mod.name}:{'.'.join(fnode.cls_scope)}.{target.attr}",
+                kind, f"{fnode.cls_scope[-1]}.{target.attr}",
+                ctx.rel_path, assign.lineno)
+            self.class_locks.setdefault(key, {})[target.attr] = decl
+        elif isinstance(target, ast.Name):
+            owner = ctx.enclosing_function(assign)
+            if owner is None:
+                leaf = mod.name.split(".")[-1]
+                decl = self._mk(f"{mod.name}:{target.id}", kind,
+                                f"{leaf}.{target.id}", ctx.rel_path,
+                                assign.lineno)
+                self.module_locks.setdefault(mod.name, {})[target.id] = decl
+            else:
+                fnode = cg.by_node.get(id(owner))
+                if fnode is None:
+                    return
+                decl = self._mk(f"{fnode.qualname}.<local>.{target.id}",
+                                kind, target.id, ctx.rel_path, assign.lineno)
+                self.local_locks.setdefault(
+                    fnode.qualname, {})[target.id] = decl
+
+    def _mk(self, lock_id: str, kind: str, display: str, path: str,
+            line: int) -> LockDecl:
+        # First declaration wins; re-assignment of the same field keeps one
+        # identity (it is the same slot).
+        decl = self.by_id.get(lock_id)
+        if decl is None:
+            decl = LockDecl(lock_id, kind, display, path, line)
+            self.by_id[lock_id] = decl
+        return decl
+
+    # ------------------------------------------------------------ resolve
+    def resolve_decl(self, fnode, expr: ast.AST) -> Optional[LockDecl]:
+        """The declaration a lock expression denotes inside ``fnode``."""
+        mod = fnode.module
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and fnode.cls_scope):
+            return self.class_locks.get(
+                (mod.name, fnode.cls_scope), {}).get(expr.attr)
+        if isinstance(expr, ast.Name):
+            decl = self.local_locks.get(fnode.qualname, {}).get(expr.id)
+            if decl is not None:
+                return decl
+            decl = self.module_locks.get(mod.name, {}).get(expr.id)
+            if decl is not None:
+                return decl
+            target = mod.refs.get(expr.id)
+            if target:
+                return self._module_symbol(target)
+            return None
+        if isinstance(expr, ast.Attribute):
+            dotted = mod.ctx.resolve(expr)
+            if dotted:
+                return self._module_symbol(dotted)
+        return None
+
+    def _module_symbol(self, dotted: str) -> Optional[LockDecl]:
+        resolved = self.project.resolve_symbol(dotted)
+        if resolved is None:
+            return None
+        tmod, sym = resolved
+        if sym and "." not in sym:
+            return self.module_locks.get(tmod.name, {}).get(sym)
+        return None
+
+    def held_resolver(self, fnode):
+        """Resolver for the lock-set domain: only held-kind identities."""
+        def resolve(expr: ast.AST) -> Optional[str]:
+            decl = self.resolve_decl(fnode, expr)
+            if decl is not None and decl.kind in _HELD_KINDS:
+                return decl.lock_id
+            return None
+        return resolve
+
+
+# ---------------------------------------------------------------------------
+# Per-function summaries
+# ---------------------------------------------------------------------------
+
+LockSet = FrozenSet[str]
+
+
+@dataclasses.dataclass
+class FnLockSummary:
+    fn: object  # FuncNode
+    # (decl, site node, locks definitely held before the acquisition)
+    acquires: List[Tuple[LockDecl, ast.AST, LockSet]]
+    # (description, own lock id or None, site node, locks held)
+    waits: List[Tuple[str, Optional[str], ast.AST, LockSet]]
+    # (callee qualname, call node, locks held) — held-nonempty calls only
+    calls: List[Tuple[str, ast.AST, LockSet]]
+
+
+def _interesting(fn_node: ast.AST) -> bool:
+    """Cheap prefilter: anything lock-shaped in this body at all?"""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_ATTRS + ("acquire",)):
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+class LockFlow:
+    """The composed, project-wide view: summaries, order graph, findings."""
+
+    def __init__(self, project) -> None:
+        self.project = project
+        self.cg = project.callgraph
+        self.registry = LockRegistry(project)
+        self.summaries: Dict[str, FnLockSummary] = {}
+        self._unique_methods = self._index_unique_methods()
+        for fn in self.cg.functions.values():
+            if _interesting(fn.node):
+                summary = self._summarize(fn)
+                if summary.acquires or summary.waits or summary.calls:
+                    self.summaries[fn.qualname] = summary
+        # Transitive facts keyed by function qualname.
+        self.inner_acquires: Dict[str, Dict[str, Tuple[str, object]]] = {}
+        self.inner_waits: Dict[
+            str, Dict[Tuple[str, Optional[str]], Tuple[str, object]]] = {}
+        # (held, acquired) -> representative witness steps
+        self.edges: Dict[Tuple[str, str], List[dict]] = {}
+        self.inversions: List[dict] = []
+        self.wait_findings: List[dict] = []
+        self._compose()
+
+    # ----------------------------------------------------------- indexing
+    def _index_unique_methods(self) -> Dict[str, Optional[str]]:
+        """Leaf method name -> qualname when project-unique, else None.
+
+        The fallback for calls like ``self.pool.checkout()`` whose receiver
+        type is unknown statically: if exactly one class method in the whole
+        project bears the name, assume it is the target.  Under-approximate
+        on ambiguity — a wrong edge would fabricate deadlocks.
+        """
+        seen: Dict[str, Optional[str]] = {}
+        for fn in self.cg.functions.values():
+            if not fn.cls_scope:
+                continue
+            leaf = fn.scope[-1]
+            seen[leaf] = None if leaf in seen else fn.qualname
+        return seen
+
+    def display(self, lock_id: str) -> str:
+        decl = self.registry.by_id.get(lock_id)
+        return decl.display if decl is not None else lock_id
+
+    # --------------------------------------------------------- summaries
+    def _summarize(self, fn) -> FnLockSummary:
+        mod = fn.module
+        cfg = build_cfg(fn.node)
+        analysis = LockSetAnalysis(self.registry.held_resolver(fn))
+        in_facts = solve(cfg, analysis)
+        summary = FnLockSummary(fn, [], [], [])
+        seen_calls: Set[int] = set()
+        for event, fact in iter_event_facts(cfg, analysis, in_facts):
+            if isinstance(event, WithEnter):
+                decl = self.registry.resolve_decl(
+                    fn, _strip_acquire_call(event.item.context_expr))
+                if decl is not None and decl.kind in _HELD_KINDS:
+                    summary.acquires.append(
+                        (decl, event.item.context_expr, fact))
+                continue
+            for node in iter_event_nodes(event):
+                if not isinstance(node, ast.Call) or id(node) in seen_calls:
+                    continue
+                seen_calls.add(id(node))
+                self._scan_call(fn, mod, node, fact, summary)
+        return summary
+
+    def _scan_call(self, fn, mod, node: ast.Call, fact: LockSet,
+                   summary: FnLockSummary) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            decl = self.registry.resolve_decl(fn, func.value)
+            if func.attr == "acquire" and decl is not None \
+                    and decl.kind in _HELD_KINDS:
+                summary.acquires.append((decl, node, fact))
+                return
+            if func.attr in _BLOCKING_ATTRS and decl is not None:
+                wait = self._blocking_record(decl, func.attr, node, fact)
+                if wait is not None:
+                    summary.waits.append(wait)
+                    return
+        if not fact:
+            return
+        qual = self.cg.resolve_callable(mod, func, fn.scope, fn.cls_scope)
+        if (qual is None and isinstance(func, ast.Attribute)
+                and not (isinstance(func.value, ast.Name)
+                         and func.value.id == "self")):
+            qual = self._unique_methods.get(func.attr)
+        if qual is not None and qual != fn.qualname:
+            summary.calls.append((qual, node, fact))
+
+    @staticmethod
+    def _blocking_record(decl: LockDecl, attr: str, node: ast.Call,
+                         fact: LockSet):
+        desc = f"`{decl.display}.{attr}()`"
+        if attr in ("wait", "wait_for"):
+            if decl.kind == "condition":
+                return (desc, decl.lock_id, node, fact)
+            if decl.kind == "event":
+                return (desc, None, node, fact)
+            return None
+        if attr == "get" and decl.kind == "queue":
+            for kw in node.keywords:
+                if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False):
+                    return None  # non-blocking get
+            return (desc, None, node, fact)
+        if attr == "join" and decl.kind in ("thread", "queue"):
+            return (desc, None, node, fact)
+        return None
+
+    # -------------------------------------------------------- composition
+    def _call_edges(self, fn) -> Iterator[str]:
+        for target, is_call in fn.edges:
+            if is_call:
+                yield target
+        summary = self.summaries.get(fn.qualname)
+        if summary is not None:
+            for qual, _node, _held in summary.calls:
+                yield qual  # includes by-name fallback targets
+
+    def _compose(self) -> None:
+        for qual, s in self.summaries.items():
+            mine = self.inner_acquires.setdefault(qual, {})
+            for decl, node, _held in s.acquires:
+                mine.setdefault(decl.lock_id, ("direct", node))
+            waits = self.inner_waits.setdefault(qual, {})
+            for desc, own, node, _held in s.waits:
+                waits.setdefault((desc, own), ("direct", node))
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.cg.functions.values():
+                for callee in self._call_edges(fn):
+                    for lock_id in self.inner_acquires.get(callee, ()):
+                        mine = self.inner_acquires.setdefault(
+                            fn.qualname, {})
+                        if lock_id not in mine:
+                            mine[lock_id] = ("via", callee)
+                            changed = True
+                    for key in self.inner_waits.get(callee, ()):
+                        mine_w = self.inner_waits.setdefault(
+                            fn.qualname, {})
+                        if key not in mine_w:
+                            mine_w[key] = ("via", callee)
+                            changed = True
+        self._build_edges()
+        self._find_inversions()
+        self._find_wait_findings()
+
+    def _rel_path(self, qual: str) -> str:
+        return self.cg.functions[qual].module.ctx.rel_path
+
+    def _step(self, text: str, path: str, line: int) -> dict:
+        return {"message": text, "path": path, "line": line}
+
+    def _acquire_chain(self, qual: str, lock_id: str) -> List[dict]:
+        """Witness steps from ``qual`` down to the concrete acquisition."""
+        steps: List[dict] = []
+        cur = qual
+        for _ in range(len(self.cg.functions) + 1):  # cycle guard
+            how, val = self.inner_acquires[cur][lock_id]
+            if how == "direct":
+                steps.append(self._step(
+                    f"`{cur}` acquires `{self.display(lock_id)}`",
+                    self._rel_path(cur), getattr(val, "lineno", 1)))
+                return steps
+            callee = val
+            steps.append(self._step(
+                f"`{cur}` calls `{callee}`", self._rel_path(cur),
+                self.cg.functions[cur].node.lineno))
+            cur = callee
+        return steps
+
+    def _wait_chain(self, qual: str,
+                    key: Tuple[str, Optional[str]]) -> List[dict]:
+        steps: List[dict] = []
+        cur = qual
+        for _ in range(len(self.cg.functions) + 1):
+            how, val = self.inner_waits[cur][key]
+            if how == "direct":
+                steps.append(self._step(
+                    f"`{cur}` blocks on {key[0]}",
+                    self._rel_path(cur), getattr(val, "lineno", 1)))
+                return steps
+            callee = val
+            steps.append(self._step(
+                f"`{cur}` calls `{callee}`", self._rel_path(cur),
+                self.cg.functions[cur].node.lineno))
+            cur = callee
+        return steps
+
+    def _add_edge(self, held: str, acquired: str,
+                  steps: List[dict]) -> None:
+        self.edges.setdefault((held, acquired), steps)
+
+    def _build_edges(self) -> None:
+        for qual, s in self.summaries.items():
+            path = self._rel_path(qual)
+            for decl, node, held in s.acquires:
+                for h in held:
+                    if h == decl.lock_id:
+                        continue  # RLock re-entry is not an order edge
+                    self._add_edge(h, decl.lock_id, [self._step(
+                        f"`{qual}` acquires `{decl.display}` while "
+                        f"holding `{self.display(h)}`",
+                        path, getattr(node, "lineno", 1))])
+            for callee, node, held in s.calls:
+                inner = self.inner_acquires.get(callee)
+                if not inner:
+                    continue
+                for lock_id in inner:
+                    for h in held:
+                        if h == lock_id:
+                            continue
+                        steps = [self._step(
+                            f"`{qual}` holds `{self.display(h)}` at the "
+                            f"call to `{callee}`",
+                            path, getattr(node, "lineno", 1))]
+                        steps += self._acquire_chain(callee, lock_id)
+                        self._add_edge(h, lock_id, steps)
+
+    # ------------------------------------------------------------ cycles
+    def _find_inversions(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for held, acquired in self.edges:
+            adj.setdefault(held, set()).add(acquired)
+            adj.setdefault(acquired, set())
+        reach: Dict[str, Set[str]] = {}
+        for start in adj:
+            seen: Set[str] = set()
+            stack = [start]
+            while stack:
+                cur = stack.pop()
+                for nxt in adj[cur]:
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            reach[start] = seen
+        # SCCs over mutual reachability; one report per component.
+        assigned: Set[str] = set()
+        for a in sorted(adj):
+            if a in assigned or a not in reach[a]:
+                continue  # not on any cycle
+            scc = {b for b in adj if a in reach[b] and b in reach[a]}
+            assigned |= scc
+            cycle = self._shortest_cycle(a, scc, adj)
+            if cycle is None:
+                continue
+            chains = [self.edges[edge] for edge in cycle]
+            locks = " -> ".join(self.display(e[0]) for e in cycle)
+            detail = "; versus ".join(
+                " -> ".join(step["message"] for step in chain)
+                for chain in chains)
+            anchor = chains[0][0]
+            self.inversions.append({
+                "path": anchor["path"], "line": anchor["line"],
+                "flows": chains,
+                "message": (
+                    f"lock-order inversion (`{locks}` -> "
+                    f"`{self.display(cycle[0][0])}`): {detail} — these "
+                    "orders deadlock when the threads interleave"),
+            })
+
+    @staticmethod
+    def _shortest_cycle(start: str, scc: Set[str],
+                        adj: Dict[str, Set[str]]
+                        ) -> Optional[List[Tuple[str, str]]]:
+        """Shortest edge path start -> ... -> start within the SCC."""
+        parents: Dict[str, Optional[str]] = {start: None}
+        order = [start]
+        i = 0
+        while i < len(order):
+            cur = order[i]
+            i += 1
+            for nxt in sorted(adj[cur] & scc):
+                if nxt == start:
+                    path = [(cur, start)]
+                    while parents[cur] is not None:
+                        path.append((parents[cur], cur))
+                        cur = parents[cur]
+                    return list(reversed(path))
+                if nxt not in parents:
+                    parents[nxt] = cur
+                    order.append(nxt)
+        return None
+
+    # ------------------------------------------------------------- waits
+    def _find_wait_findings(self) -> None:
+        reported: Set[Tuple[str, int]] = set()
+
+        def emit(path: str, node: ast.AST, message: str) -> None:
+            key = (path, getattr(node, "lineno", 1))
+            if key not in reported:
+                reported.add(key)
+                self.wait_findings.append(
+                    {"path": path, "line": key[1],
+                     "col": getattr(node, "col_offset", 0),
+                     "message": message})
+
+        for qual, s in self.summaries.items():
+            path = self._rel_path(qual)
+            for desc, own, node, held in s.waits:
+                foreign = held - {own} if own else held
+                if not foreign:
+                    continue
+                names = ", ".join(sorted(
+                    f"`{self.display(h)}`" for h in foreign))
+                release = (" (the condition releases its own lock during "
+                           "the wait; the others stay held)" if own else "")
+                emit(path, node,
+                     f"blocks on {desc} while holding {names}{release} — "
+                     "every thread needing those locks stalls for the "
+                     "duration of the wait")
+            for callee, node, held in s.calls:
+                for key, _how in self.inner_waits.get(callee, {}).items():
+                    desc, own = key
+                    foreign = held - {own} if own else held
+                    if not foreign:
+                        continue
+                    names = ", ".join(sorted(
+                        f"`{self.display(h)}`" for h in foreign))
+                    chain = " -> ".join(
+                        step["message"]
+                        for step in self._wait_chain(callee, key))
+                    emit(path, node,
+                         f"holds {names} across a call that blocks on "
+                         f"{desc}: {chain} — the held locks are pinned "
+                         "for the full wait")
+
+
+def lock_flow(project) -> LockFlow:
+    flow = getattr(project, "_lock_flow", None)
+    if flow is None:
+        flow = LockFlow(project)
+        project._lock_flow = flow
+    return flow
+
+
+class _Anchor:
+    """Line/col shim so Rule.finding can anchor precomputed findings."""
+
+    __slots__ = ("lineno", "col_offset")
+
+    def __init__(self, line: int, col: int = 0) -> None:
+        self.lineno = line
+        self.col_offset = col
+
+
+# ---------------------------------------------------------------------------
+# VMT119 / VMT120
+# ---------------------------------------------------------------------------
+
+
+class LockOrderInversion(Rule):
+    id = "VMT119"
+    name = "lock-order-inversion"
+    severity = "error"
+    description = ("Cycle in the project-wide lock-acquisition-order graph "
+                   "(ABBA deadlock candidate), with one witness chain per "
+                   "conflicting order.")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        flow = lock_flow(ctx.project)
+        for inv in flow.inversions:
+            if inv["path"] != ctx.rel_path:
+                continue
+            f = self.finding(ctx, _Anchor(inv["line"]), inv["message"])
+            f.flows = [list(chain) for chain in inv["flows"]]
+            yield f
+
+
+class WaitHoldingForeignLock(Rule):
+    id = "VMT120"
+    name = "wait-holding-foreign-lock"
+    severity = "error"
+    description = ("Condition.wait / queue.get / join / Event.wait reached "
+                   "while the lock-set holds any lock other than the "
+                   "condition's own.")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        flow = lock_flow(ctx.project)
+        for w in flow.wait_findings:
+            if w["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(w["line"], w["col"]),
+                               w["message"])
+
+
+# ---------------------------------------------------------------------------
+# VMT121 jit-closure-capture
+# ---------------------------------------------------------------------------
+
+
+def _free_loads(body: ast.AST) -> Set[str]:
+    """Names the (jitted) body reads from an enclosing scope."""
+    bound: Set[str] = set()
+    loads: Set[str] = set()
+    for node in ast.walk(body):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.add(node.id)
+            else:
+                bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            args = node.args
+            for a in (args.args + args.posonlyargs + args.kwonlyargs):
+                bound.add(a.arg)
+            if args.vararg:
+                bound.add(args.vararg.arg)
+            if args.kwarg:
+                bound.add(args.kwarg.arg)
+            if not isinstance(node, ast.Lambda):
+                bound.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+    return loads - bound
+
+
+def _own_assigned_names(fn: ast.AST) -> Set[str]:
+    """Locals of ``fn``: params plus names stored outside nested scopes."""
+    names: Set[str] = set()
+    args = fn.args
+    for a in (args.args + args.posonlyargs + args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            if not isinstance(node, ast.Lambda):
+                names.add(node.name)
+            continue
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+class JitClosureCapture(Rule):
+    id = "VMT121"
+    name = "jit-closure-capture"
+    severity = "error"
+    description = ("Flow-sensitive VMT102: a jitted closure captures a value "
+                   "that has more than one definition reaching the traced "
+                   "region, or reads mutable self./global state at trace "
+                   "time.")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._local_rebinds(ctx)
+        yield from self._mutable_trace_reads(ctx)
+
+    # ----------------------------------------------- captured local rebinds
+    def _local_rebinds(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            creations = list(self._jit_creations(ctx, fn))
+            if not creations:
+                continue
+            fn_locals = _own_assigned_names(fn)
+            for bound, body in creations:
+                captured = frozenset(
+                    (_free_loads(body) & fn_locals) - {bound})
+                if not captured:
+                    continue
+                yield from self._check_captures(ctx, fn, bound, captured)
+
+    def _jit_creations(self, ctx: ModuleContext, fn: ast.AST
+                       ) -> Iterator[Tuple[str, ast.AST]]:
+        """(bound name, jitted body) pairs created directly inside ``fn``."""
+        nested = {child.name: child for child in ast.walk(fn)
+                  if isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                  and child is not fn}
+        for node in ast.iter_child_nodes(fn):
+            stack = [node]
+            while stack:
+                cur = stack.pop()
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if any(ctx.is_jit_entry(
+                            d.func if isinstance(d, ast.Call) else d)
+                           for d in cur.decorator_list):
+                        yield cur.name, cur
+                    continue
+                if (isinstance(cur, ast.Assign)
+                        and isinstance(cur.value, ast.Call)
+                        and ctx.is_jit_entry(cur.value.func)
+                        and cur.value.args):
+                    target_fn = cur.value.args[0]
+                    body: Optional[ast.AST] = None
+                    if isinstance(target_fn, ast.Lambda):
+                        body = target_fn
+                    elif isinstance(target_fn, ast.Name):
+                        body = nested.get(target_fn.id)
+                    if body is not None:
+                        for t in cur.targets:
+                            if isinstance(t, ast.Name):
+                                yield t.id, body
+                stack.extend(ast.iter_child_nodes(cur))
+
+    def _check_captures(self, ctx: ModuleContext, fn: ast.AST, bound: str,
+                        captured: FrozenSet[str]) -> Iterator[Finding]:
+        cfg = build_cfg(fn)
+        analysis = ReachingDefs(captured, params_line=fn.lineno)
+        in_facts = solve(cfg, analysis)
+        per_name: Dict[str, Set[int]] = {}
+        flagged: Set[str] = set()
+        for event, fact in iter_event_facts(cfg, analysis, in_facts):
+            for node in iter_event_nodes(event):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == bound):
+                    continue
+                for name in captured:
+                    if name in flagged:
+                        continue
+                    lines = {line for n, line in fact if n == name}
+                    seen = per_name.setdefault(name, set())
+                    seen |= lines
+                    if len(seen) > 1:
+                        flagged.add(name)
+                        where = ", ".join(
+                            str(ln) if ln else "entry"
+                            for ln in sorted(seen))
+                        yield self.finding(
+                            ctx, node,
+                            f"`{name}` is captured by the jitted `{bound}` "
+                            f"but has multiple definitions reaching its "
+                            f"calls (lines {where}) — the first trace bakes "
+                            f"one value and later calls silently reuse that "
+                            f"stale constant; pass `{name}` as an argument "
+                            f"instead")
+
+    # --------------------------------------------- mutable trace-time reads
+    def _mutable_trace_reads(self, ctx: ModuleContext) -> Iterator[Finding]:
+        rebound_globals = self._rebound_globals(ctx)
+        mutable_cache: Dict[int, Dict[str, str]] = {}
+        for info in ctx.jit_bodies:
+            cls = next((a for a in ctx.ancestors(info.body)
+                        if isinstance(a, ast.ClassDef)), None)
+            reported: Set[str] = set()
+            if cls is not None:
+                mutable = mutable_cache.get(id(cls))
+                if mutable is None:
+                    mutable = self._class_mutable_attrs(cls)
+                    mutable_cache[id(cls)] = mutable
+                aliases = self._self_aliases(ctx, info.body)
+                for node in ast.walk(info.body):
+                    if not (isinstance(node, ast.Attribute)
+                            and isinstance(node.ctx, ast.Load)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id in aliases):
+                        continue
+                    if node.attr in mutable and node.attr not in reported:
+                        reported.add(node.attr)
+                        yield self.finding(
+                            ctx, node,
+                            f"jit-traced code reads `self.{node.attr}`, "
+                            f"which `{mutable[node.attr]}` rebinds — the "
+                            f"value is baked in at trace time, so a rebind "
+                            f"after tracing leaves the compiled program on "
+                            f"the stale value; hoist it to a local and pass "
+                            f"it as an argument (or key the compile cache "
+                            f"on it)")
+            for node in ast.walk(info.body):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in rebound_globals
+                        and node.id not in reported):
+                    reported.add(node.id)
+                    yield self.finding(
+                        ctx, node,
+                        f"jit-traced code reads module global `{node.id}`, "
+                        f"which `{rebound_globals[node.id]}` rebinds via "
+                        f"`global` — the traced program keeps whichever "
+                        f"value was live at trace time")
+
+    @staticmethod
+    def _self_aliases(ctx: ModuleContext, body: ast.AST) -> Set[str]:
+        aliases = {"self"}
+        encl = ctx.enclosing_function(body)
+        if encl is not None:
+            for node in ast.walk(encl):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+        return aliases
+
+    @staticmethod
+    def _class_mutable_attrs(cls: ast.ClassDef) -> Dict[str, str]:
+        """self.* attrs rebound outside __init__-like methods -> witness."""
+        mutable: Dict[str, str] = {}
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if stmt.name in _INIT_METHODS:
+                continue
+            for node in ast.walk(stmt):
+                targets: List[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        mutable.setdefault(t.attr, stmt.name)
+        return mutable
+
+    @staticmethod
+    def _rebound_globals(ctx: ModuleContext) -> Dict[str, str]:
+        """Module-level names some function rebinds via `global` -> fn."""
+        module_names: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_names.add(t.id)
+            elif (isinstance(stmt, ast.AnnAssign)
+                  and isinstance(stmt.target, ast.Name)):
+                module_names.add(stmt.target.id)
+        rebound: Dict[str, str] = {}
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Store) and node.id in declared \
+                        and node.id in module_names:
+                    rebound.setdefault(node.id, fn.name)
+        return rebound

@@ -65,18 +65,38 @@ def render_sarif(new: Sequence[Finding], baselined: Sequence[Finding],
         "defaultConfiguration": {
             "level": _SARIF_LEVEL.get(cls.severity, "warning")},
     } for cls in RULES]
-    results = [{
-        "ruleId": f.rule,
-        "level": _SARIF_LEVEL.get(f.severity, "warning"),
-        "message": {"text": f.message},
-        "partialFingerprints": {"vmtlint/v1": f.fingerprint()},
-        "locations": [{
-            "physicalLocation": {
-                "artifactLocation": {"uri": f.path},
-                "region": {"startLine": f.line, "startColumn": f.col},
-            },
-        }],
-    } for f in new]
+    results = []
+    for f in new:
+        result = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "partialFingerprints": {"vmtlint/v1": f.fingerprint()},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line, "startColumn": f.col},
+                },
+            }],
+        }
+        if f.flows:
+            # Witness chains (VMT119 reports one per conflicting lock
+            # order) as threadFlows — clickable step-by-step in SARIF
+            # viewers.
+            result["codeFlows"] = [{
+                "threadFlows": [{
+                    "locations": [{
+                        "location": {
+                            "physicalLocation": {
+                                "artifactLocation": {"uri": step["path"]},
+                                "region": {"startLine": step["line"]},
+                            },
+                            "message": {"text": step["message"]},
+                        },
+                    } for step in flow],
+                }],
+            } for flow in f.flows]
+        results.append(result)
     return json.dumps({
         "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),

@@ -1563,13 +1563,261 @@ class DequantOutsideJit(Rule):
                         "consuming matmul")
 
 
+# --------------------------------------------------------------------- 122
+class ConfigKnobDrift(Rule):
+    """ServingConfig/EngineConfig fields vs. what the project actually reads.
+
+    Two drift directions, both real after PRs 5-9 added 40+ knobs: a knob
+    declared but never read anywhere (dead weight that silently ignores the
+    operator's intent), and an attribute read that matches no declared field
+    (a typo that returns AttributeError at runtime — or worse, never runs).
+    Reads are recognized by their access spelling: ``*.serving.<knob>`` /
+    ``*._serving.<knob>`` for ServingConfig, ``*cfg.engine.<knob>`` for
+    EngineConfig — the only idioms the codebase uses.
+    """
+
+    id = "VMT122"
+    name = "config-knob-drift"
+    severity = "warning"
+    description = ("ServingConfig/EngineConfig knob declared but never read "
+                   "anywhere in the project, or an attribute read matching "
+                   "no declared knob (typo detector)")
+
+    _SERVING_BASES = ("serving", "_serving")
+    _ENGINE_CLS = "EngineConfig"
+    _SERVING_CLS = "ServingConfig"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Set by the --changed driver: a subset scan cannot prove a knob is
+        # read *nowhere*, so the dead-knob direction is suppressed there.
+        self.partial_scan = False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        audit = _knob_audit(ctx.project)
+        if not self.partial_scan:
+            for cls_name, field, node, rel in audit["declared"]:
+                if rel != ctx.rel_path:
+                    continue
+                if field in audit["reads"].get(cls_name, set()):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"`{cls_name}.{field}` is declared but never read "
+                    f"anywhere in the scanned project — a dead knob "
+                    f"silently ignores whatever the operator sets it to; "
+                    f"wire it up or delete it")
+        for rel, node, cls_name, attr in audit["suspect_reads"]:
+            if rel != ctx.rel_path:
+                continue
+            import difflib
+
+            close = difflib.get_close_matches(
+                attr, sorted(audit["members"].get(cls_name, ())), n=2)
+            hint = f" (did you mean {' or '.join(close)}?)" if close else ""
+            yield self.finding(
+                ctx, node,
+                f"`.{attr}` matches no declared {cls_name} field{hint} — "
+                f"a typo here raises AttributeError on the serving path, "
+                f"or reads a knob that no longer exists")
+
+
+def _knob_audit(project) -> Dict:
+    """Cross-module knob audit, cached on the ProjectGraph."""
+    cached = getattr(project, "_knob_audit", None)
+    if cached is not None:
+        return cached
+    audited = (ConfigKnobDrift._SERVING_CLS, ConfigKnobDrift._ENGINE_CLS)
+    declared: List[Tuple[str, str, ast.AST, str]] = []
+    members: Dict[str, Set[str]] = {}
+    for mod in project.modules.values():
+        for node in ast.walk(mod.ctx.tree):
+            if not (isinstance(node, ast.ClassDef) and node.name in audited):
+                continue
+            mem = members.setdefault(node.name, set())
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    declared.append((node.name, stmt.target.id, stmt,
+                                     mod.ctx.rel_path))
+                    mem.add(stmt.target.id)
+                elif isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            declared.append((node.name, t.id, stmt,
+                                             mod.ctx.rel_path))
+                            mem.add(t.id)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    mem.add(stmt.name)
+    reads: Dict[str, Set[str]] = {}
+    suspects: List[Tuple[str, ast.AST, str, str]] = []
+    seen_suspects: Set[int] = set()
+
+    def record(mod, node: ast.AST, cls_name: str, attr: str) -> None:
+        reads.setdefault(cls_name, set()).add(attr)
+        if (members.get(cls_name) and attr not in members[cls_name]
+                and not attr.startswith("__")
+                and id(node) not in seen_suspects):
+            seen_suspects.add(id(node))
+            suspects.append((mod.ctx.rel_path, node, cls_name, attr))
+
+    for mod in project.modules.values():
+        tree = mod.ctx.tree
+        module_aliases = _knob_aliases(tree)
+        for scope in ast.walk(tree):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                aliases = dict(module_aliases)
+                aliases.update(_knob_aliases(scope))
+            elif isinstance(scope, ast.Module):
+                aliases = module_aliases
+            else:
+                continue
+            for node in ast.walk(scope):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)):
+                    cls_name = _knob_base_class(node.value)
+                    if cls_name is None and isinstance(node.value, ast.Name):
+                        cls_name = aliases.get(node.value.id)
+                    if cls_name is not None:
+                        record(mod, node, cls_name, node.attr)
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in ("getattr", "hasattr")
+                        and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and isinstance(node.args[1].value, str)):
+                    # getattr(api.serving, "admin_token", None) is a read
+                    # too — and has a default, so never a typo suspect.
+                    base = node.args[0]
+                    cls_name = _knob_value_class(base)
+                    if cls_name is None and isinstance(base, ast.Name):
+                        cls_name = aliases.get(base.id)
+                    if cls_name is not None:
+                        reads.setdefault(cls_name, set()).add(
+                            node.args[1].value)
+    audit = {"declared": declared, "members": members, "reads": reads,
+             "suspect_reads": suspects}
+    project._knob_audit = audit
+    return audit
+
+
+def _knob_aliases(scope: ast.AST) -> Dict[str, str]:
+    """Local names that denote an audited config object in ``scope``:
+    annotated parameters (``ecfg: EngineConfig``) and assignment aliases
+    (``s = cfg.serving``, ``s = serving or ServingConfig()``)."""
+    aliases: Dict[str, str] = {}
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = scope.args
+        for arg in (list(getattr(a, "posonlyargs", ())) + a.args
+                    + a.kwonlyargs):
+            cls = _annotation_class(arg.annotation)
+            if cls is not None:
+                aliases[arg.arg] = cls
+        stmts: List[ast.AST] = list(ast.walk(scope))
+    else:
+        # Module scope: only direct top-level statements — function-local
+        # names must not leak into the module alias map.
+        stmts = list(getattr(scope, "body", ()))
+    for node in stmts:
+        if isinstance(node, ast.Assign):
+            cls = _knob_value_class(node.value)
+            if cls is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases[t.id] = cls
+        elif (isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)):
+            cls = (_annotation_class(node.annotation)
+                   or (_knob_value_class(node.value)
+                       if node.value is not None else None))
+            if cls is not None:
+                aliases[node.target.id] = cls
+    return aliases
+
+
+def _annotation_class(ann: Optional[ast.expr]) -> Optional[str]:
+    """ServingConfig/EngineConfig named anywhere in a type annotation,
+    including ``Optional[...]`` wrappers and string annotations."""
+    if ann is None:
+        return None
+    names = (ConfigKnobDrift._SERVING_CLS, ConfigKnobDrift._ENGINE_CLS)
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        for n in names:
+            if n in ann.value:
+                return n
+        return None
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name) and node.id in names:
+            return node.id
+        if isinstance(node, ast.Attribute) and node.attr in names:
+            return node.attr
+    return None
+
+
+def _knob_value_class(value: ast.expr) -> Optional[str]:
+    """Which audited config class an rvalue expression denotes, if any."""
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            cls = _knob_value_class(v)
+            if cls is not None:
+                return cls
+        return None
+    if isinstance(value, ast.Call):
+        f = value.func
+        term = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None)
+        if term in (ConfigKnobDrift._SERVING_CLS,
+                    ConfigKnobDrift._ENGINE_CLS):
+            return term
+        return None
+    if isinstance(value, ast.Attribute):
+        if value.attr in ConfigKnobDrift._SERVING_BASES:
+            return ConfigKnobDrift._SERVING_CLS
+        if value.attr == "engine":
+            base = value.value
+            iterm = (base.id if isinstance(base, ast.Name)
+                     else base.attr if isinstance(base, ast.Attribute)
+                     else None)
+            if iterm is not None and (iterm == "cfg"
+                                      or iterm.endswith("_cfg")):
+                return ConfigKnobDrift._ENGINE_CLS
+    return None
+
+
+def _knob_base_class(base: ast.expr) -> Optional[str]:
+    """Which audited config class an attribute-access base denotes."""
+    if isinstance(base, ast.Name):
+        term = base.id
+    elif isinstance(base, ast.Attribute):
+        term = base.attr
+    else:
+        return None
+    if term in ConfigKnobDrift._SERVING_BASES:
+        return ConfigKnobDrift._SERVING_CLS
+    if term == "engine" and isinstance(base, ast.Attribute):
+        inner = base.value
+        iterm = (inner.id if isinstance(inner, ast.Name)
+                 else inner.attr if isinstance(inner, ast.Attribute)
+                 else None)
+        if iterm is not None and (iterm == "cfg" or iterm.endswith("_cfg")):
+            return ConfigKnobDrift._ENGINE_CLS
+    return None
+
+
+from vilbert_multitask_tpu.analysis.locks import (  # noqa: E402
+    JitClosureCapture, LockOrderInversion, WaitHoldingForeignLock)
+
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
          SwallowedException, ModuleLevelNumpyMutation, WallClockDuration,
          LockDisciplineRace, PartitionSpecAxisMismatch, LayeringViolation,
          PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer,
          BlockingCallUnderSchedulerLock, ReplicaAffinityLeak,
-         DequantOutsideJit]
+         DequantOutsideJit, LockOrderInversion, WaitHoldingForeignLock,
+         JitClosureCapture, ConfigKnobDrift]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

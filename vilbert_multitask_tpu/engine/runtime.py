@@ -872,7 +872,8 @@ class InferenceEngine:
             # Feature files are confidence-ordered (extractor top-K order,
             # same as the reference's .npy dumps), so an over-provisioned
             # store clips to this engine's region budget instead of erroring.
-            regions = clip_regions(regions, ecfg.max_regions)
+            regions = clip_regions(regions, ecfg.max_regions,
+                                   num_features=ecfg.num_features)
             encoded = [encode_image(r, ecfg.max_regions) for r in regions]
             feats, spatials, image_mask = batch_images(encoded, pad_to=bucket)
             feats = feats.astype(self.transfer_dtype, copy=False)

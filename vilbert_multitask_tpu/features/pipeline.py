@@ -20,7 +20,7 @@ dynamic shape the detector produced.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -96,13 +96,17 @@ def encode_image(region: RegionFeatures, max_regions: int = 101) -> EncodedImage
 
 
 def clip_regions(regions: Sequence[RegionFeatures],
-                 max_regions: int) -> list[RegionFeatures]:
+                 max_regions: int,
+                 num_features: Optional[int] = None) -> list[RegionFeatures]:
     """Clip over-provisioned region sets to the budget (``max_regions`` - 1
-    detector rows + the global row). Stores are confidence-ordered, so the
-    clip keeps the top boxes. The ONE clip implementation — serving
-    (engine.prepare) and training (train/loop) both use it, so a new
-    per-region field only needs slicing here."""
+    detector rows + the global row, tightened by ``num_features`` when the
+    operator wants fewer boxes than the padded shape admits). Stores are
+    confidence-ordered, so the clip keeps the top boxes. The ONE clip
+    implementation — serving (engine.prepare) and training (train/loop)
+    both use it, so a new per-region field only needs slicing here."""
     budget = max_regions - 1
+    if num_features is not None:
+        budget = min(budget, num_features)
     return [
         dataclasses.replace(
             r, features=r.features[:budget], boxes=r.boxes[:budget],

@@ -38,6 +38,13 @@ Env knobs:
   exit — ~5 minutes of evidence instead of burning the whole wall budget
   probing a tunnel that was down from the start. Once any probe succeeds
   the window is disarmed; later flakiness gets the full budget.
+- ``BENCH_PROBE_MAX_FAILS`` (6) consecutive-failed-probe cap, armed once
+  the backend has been seen alive (the hole the WINDOW leaves open): a
+  tunnel dying mid-run emits best-so-far/partial JSON after ~N probe
+  timeouts instead of spinning "probe hung" cycles to the wall budget.
+- Successful (non-partial) runs append their headline keys to
+  ``PERF_LEDGER.jsonl`` (``scripts/perf_ledger.py check`` gates on it);
+  tiny runs ledger under a separate metric name.
 - ``BENCH_ANATOMY_REPS`` (20) reps for the post-headline latency-anatomy
   probes (dispatch floor / many-arg execute / host round-trip — see
   ``_anatomy_probes``); ``BENCH_ANATOMY=0`` skips the stage.
@@ -880,6 +887,40 @@ def _emit_final(obj: dict) -> None:
         return
     _STATE["emitted"] = True
     print(json.dumps(obj), flush=True)
+    _ledger_append(obj)
+
+
+def _ledger_append(obj: dict) -> None:
+    """Perf-ledger ride-along: a run that produced a real number appends
+    its comparable keys to PERF_LEDGER.jsonl (``perf_ledger.py check``
+    diffs it against the trailing baseline window). Failure emissions —
+    value null, killed early, partial — stay out: a dead tunnel is not a
+    baseline. Best-effort: the headline JSON is already on stdout, so
+    nothing here may raise."""
+    if not isinstance(obj.get("value"), (int, float)):
+        return
+    if obj.get("partial") or obj.get("killed_early"):
+        return
+    try:
+        from vilbert_multitask_tpu import obs
+        from vilbert_multitask_tpu.config import (FrameworkConfig,
+                                                  config_fingerprint)
+
+        values = {k: obj[k] for k in (
+            "value", "p95_ms", "forward_p50_ms", "decode_p50_ms",
+            "batch_qps", "knee_rows", "init_s", "pallas_forward_speedup",
+        ) if isinstance(obj.get(k), (int, float))
+            and not isinstance(obj.get(k), bool)}
+        # Tiny smokes ledger under their own metric: a 6-layer-CPU p50
+        # median must never become the hardware run's baseline (or vice
+        # versa — check() windows are per-metric).
+        metric = "bench.p50_latency_ms" + (".tiny" if TINY else "")
+        obs.ledger_append(
+            metric, values,
+            config_fingerprint=config_fingerprint(FrameworkConfig()),
+            extra={"backend": obj.get("backend")})
+    except Exception as e:  # noqa: BLE001 — never after the emit
+        print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
 
 
 def _on_kill_signal(signum, frame) -> None:
@@ -925,6 +966,14 @@ def main() -> None:
     # round-5 builder artifact spent 1798 s learning nothing a 5-minute
     # window wouldn't have). One successful probe disarms it for the run.
     probe_window_s = float(os.environ.get("BENCH_PROBE_WINDOW_S", "300"))
+    # Mid-run dead-tunnel cap: the WINDOW above only guards a backend that
+    # was never alive — one successful probe disarms it, after which a
+    # tunnel that dies mid-run used to spin "probe hung >240s" cycles for
+    # the whole wall budget (the r04/r05 builder artifacts each burned
+    # >90 min re-learning the same dead tunnel). Once the backend HAS been
+    # seen, this caps CONSECUTIVE failed probes; any success resets it.
+    # Before first contact the window above owns the exit.
+    probe_max_fails = int(os.environ.get("BENCH_PROBE_MAX_FAILS", "6"))
     wall_budget_s = float(os.environ.get("BENCH_WALL_BUDGET_S", "7200"))
     # Below this remaining-time floor a measurement attempt cannot plausibly
     # finish (engine init alone is ~30 s + compile ~60 s + measure ~90 s,
@@ -945,6 +994,7 @@ def main() -> None:
 
     attempt = 0
     backend_ever_seen = False
+    probe_fails = 0  # consecutive; any successful probe resets
     while attempt < attempts:
         # Probe cycle: spin on cheap probes while the backend is dead —
         # never launch a child that will burn an attempt timeout learning
@@ -961,7 +1011,31 @@ def main() -> None:
             note(diag)
             if ok:
                 backend_ever_seen = True
+                probe_fails = 0
                 break
+            probe_fails += 1
+            if backend_ever_seen and probe_fails >= probe_max_fails:
+                # Tunnel died mid-run (or never recovered): stop paying
+                # probe timeouts for the same diagnosis. Emit the best
+                # number in hand — else a structured partial — NOW, while
+                # it is still our exit and not the driver's rc=124.
+                if _STATE["best"] is not None:
+                    best = dict(_STATE["best"])
+                    best["partial"] = True
+                    best["error"] = (f"{probe_fails} consecutive probe "
+                                     "failures; tunnel presumed dead")
+                    _emit_final(best)
+                else:
+                    _emit_final({
+                        "metric": "p50_latency_ms", "value": None,
+                        "unit": "ms", "vs_baseline": None, "partial": True,
+                        "error": (f"{probe_fails} consecutive probe "
+                                  "failures (BENCH_PROBE_MAX_FAILS="
+                                  f"{probe_max_fails}); probes: "
+                                  + " | ".join(_STATE["log"][-6:]))[:800],
+                        **_last_known_good(),
+                    })
+                sys.exit(1)
             if remaining() < min_attempt_s + probe_backoff_s:
                 _emit_final({
                     "metric": "p50_latency_ms", "value": None, "unit": "ms",

@@ -70,4 +70,17 @@ echo "== SLO smoke (live-health plane answers under load) =="
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py \
   --out /tmp/SLO_SMOKE.json || fail=1
 
+echo "== fleet smoke (two processes, one spine: merged metrics + stitched trace) =="
+# A second OS process flushes into the app's fleet spine; ?scope=fleet
+# must list both identities, sum the shared counter, and stitch one
+# cross-process trace timeline.
+JAX_PLATFORMS=cpu python scripts/fleet_smoke.py \
+  --out /tmp/FLEET_SMOKE.json || fail=1
+
+echo "== perf ledger (newest entries vs trailing-window baseline) =="
+# The smokes above appended their entries; regress fails the run. A
+# fresh clone has no history yet — --tolerate-empty keeps empty and
+# no-baseline verdicts green until the ledger accumulates a window.
+python scripts/perf_ledger.py check --tolerate-empty || fail=1
+
 exit "$fail"

@@ -28,7 +28,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from serve_soak import PATTERN, _build_cfg, _make_features  # noqa: E402
+from serve_soak import (  # noqa: E402
+    PATTERN,
+    _build_cfg,
+    _ledger_verdict,
+    _make_features,
+)
 
 
 def _fresh_stack(cfg, engine, root, tag, **serving_overrides):
@@ -149,6 +154,7 @@ def main(argv=None) -> int:
         "no_lost_jobs": no_lost,
         "verdict": verdict,
     }
+    _ledger_verdict(report, verdict, prefix="smoke.")
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report), flush=True)

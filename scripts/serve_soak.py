@@ -54,6 +54,37 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # whatever backend jax picks).
 
 
+def _ledger_verdict(report: dict, verdict: bool,
+                    prefix: str = "soak.") -> None:
+    """Append this run's verdict line to PERF_LEDGER.jsonl (best-effort:
+    the artifact file is the soak's contract; a read-only checkout must
+    not fail the run). Variants ledger under distinct metric names —
+    full-model and chaos runs have different latency shapes than the CI
+    tiny burst, and check() baselines are per-metric medians.
+    (sched_smoke.py reuses this with its own prefix.)"""
+    try:
+        from vilbert_multitask_tpu import obs
+
+        metric = prefix + str(report.get("metric"))
+        if report.get("model") == "full":
+            metric += ".full"
+        if "chaos" in report:
+            metric += ".chaos"
+        values = {}
+        for k in ("value", "e2e_p50_ms", "e2e_p95_ms", "boot_s",
+                  "makespan_s", "qps_ratio_vs_1_replica", "baseline_qps",
+                  "solo_qps", "sched_qps", "speedup"):
+            v = report.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                values[k] = v
+        obs.ledger_append(metric, values, extra={
+            "verdict": "pass" if verdict else "fail",
+            "backend": report.get("backend"),
+        })
+    except Exception as e:  # noqa: BLE001 — ride-along must never fail the soak
+        print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
+
+
 def _build_cfg(root: str, full: bool):
     from vilbert_multitask_tpu.config import (
         EngineConfig,
@@ -462,6 +493,7 @@ def run_pool_soak(args) -> int:
         })
     report["checks"] = checks
     verdict = all(checks.values())
+    _ledger_verdict(report, verdict)
     out = args.out or "SERVE_SOAK_POOL.json"
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
@@ -787,6 +819,7 @@ def main(argv=None) -> int:
                    and trace_in_spans)
     else:
         verdict = report["all_completed"]
+    _ledger_verdict(report, verdict)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report), flush=True)

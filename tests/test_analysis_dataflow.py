@@ -577,6 +577,92 @@ def test_vmt122_reads_through_annotated_param_and_getattr():
     assert not [f for f in _findings(src) if f.rule == "VMT122"]
 
 
+# ----------------------------------------------------------------- VMT123
+def test_vmt123_dead_instrument_flagged_at_registration():
+    src = {
+        "pkg/metrics.py": """
+        ALIVE = REGISTRY.counter("vmt_alive_total", "incremented below")
+        DEAD = REGISTRY.gauge("vmt_dead_gauge", "never touched again")
+
+        def tick():
+            ALIVE.inc()
+        """,
+    }
+    hits = [f for f in _findings(src) if f.rule == "VMT123"]
+    assert len(hits) == 1
+    assert hits[0].path == "pkg/metrics.py"
+    assert "vmt_dead_gauge" in hits[0].message
+
+
+def test_vmt123_typo_read_flagged_with_suggestion():
+    src = {
+        "pkg/metrics.py": """
+        JOBS = REGISTRY.counter("vmt_jobs_total", "jobs")
+
+        def tick():
+            JOBS.inc()
+        """,
+        # vmtlint: disable-next-line=VMT123  (the typo under test, verbatim)
+        "pkg/read.py": """
+        def snapshot(snap):
+            return snap.get("vmt_job_total", 0)
+        """,
+    }
+    hits = [f for f in _findings(src) if f.rule == "VMT123"]
+    assert len(hits) == 1
+    assert hits[0].path == "pkg/read.py"
+    assert "vmt_job_total" in hits[0].message  # vmtlint: disable=VMT123
+    assert "vmt_jobs_total" in hits[0].message  # did-you-mean suggestion
+
+
+def test_vmt123_exposition_suffixes_and_derived_rates_are_reads():
+    # _bucket/_sum/_count normalize to the histogram; the Sampler's
+    # derived *_per_s key normalizes to its *_total counter — and a
+    # name-string reference anywhere counts as keeping it alive.
+    src = {
+        "pkg/metrics.py": """
+        REGISTRY.histogram("vmt_lat_ms", "latency")
+        REGISTRY.counter("vmt_jobs_total", "jobs")
+        """,
+        "pkg/read.py": """
+        def asserts(text, series):
+            assert "vmt_lat_ms_bucket{" in text
+            assert "vmt_lat_ms_count" in text
+            return series["vmt_jobs_per_s"]
+        """,
+    }
+    assert not [f for f in _findings(src) if f.rule == "VMT123"]
+
+
+def test_vmt123_chained_registration_and_foreign_strings_are_clean():
+    src = {
+        "pkg/metrics.py": """
+        import tempfile
+
+        def hit():
+            REGISTRY.counter("vmt_hits_total", "get-or-create idiom").inc()
+            # Foreign vmt_ strings (paths, native symbols) are not reads.
+            return tempfile.mkdtemp(prefix="vmt_demo_scratch")
+        """,
+    }
+    assert not [f for f in _findings(src) if f.rule == "VMT123"]
+
+
+def test_vmt123_cross_module_handle_use_marks_alive():
+    src = {
+        "pkg/metrics.py": """
+        SHED = REGISTRY.counter("vmt_shed_jobs_total", "sheds")
+        """,
+        "pkg/worker.py": """
+        from pkg.metrics import SHED
+
+        def drop():
+            SHED.inc()
+        """,
+    }
+    assert not [f for f in _findings(src) if f.rule == "VMT123"]
+
+
 # -------------------------------------------------------- --changed mode
 def test_import_closure_reverse_and_forward():
     sources = {

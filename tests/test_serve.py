@@ -1032,7 +1032,10 @@ def test_serveapp_start_exposes_build_info_uptime_and_recorder(
         assert f'config_fingerprint="{app.fingerprint}"' in info_line
         assert 'backend="cpu"' in info_line
         assert float(info_line.rsplit(" ", 1)[1]) == 1.0
-        assert any(ln.startswith("vmt_uptime_seconds ")
+        # Default identity labels (Registry.set_default_labels, stamped by
+        # ServeApp.start) ride every exposition sample.
+        assert any(ln.startswith("vmt_uptime_seconds{")
+                   and f'instance="{app.identity.ident}"' in ln
                    for ln in text.splitlines())
 
         # the background sampler feeds the time-series store

@@ -1807,6 +1807,190 @@ def _knob_base_class(base: ast.expr) -> Optional[str]:
     return None
 
 
+# --------------------------------------------------------------------- 123
+class InstrumentNameDrift(Rule):
+    """Registered ``vmt_*`` instruments vs. the names the project reads.
+
+    The VMT122 pattern applied to the metrics namespace. Two drift
+    directions: an instrument registered (``REGISTRY.counter("vmt_x")``)
+    whose handle is never used and whose name no string ever references —
+    dead weight every exposition renders and every fleet flush ships —
+    and a name-string read (a snapshot key lookup, a test asserting an
+    exposition line) that matches no registered instrument: reads by
+    name fail SILENTLY (a missing dict key, an assertion against a line
+    that can never exist), so a typo here is a metric that quietly
+    flatlines. Exposition suffixes (``_bucket``/``_sum``/``_count``) and
+    the Sampler's derived ``*_per_s``-from-``*_total`` rates normalize to
+    their base instrument; foreign ``vmt_``-prefixed strings (temp dirs,
+    native symbols) are ignored unless they sit within typo distance of a
+    real instrument name.
+    """
+
+    id = "VMT123"
+    name = "instrument-name-drift"
+    severity = "warning"
+    description = ("vmt_* instrument registered but never written or "
+                   "referenced anywhere (dead metric), or a name-string "
+                   "read matching no registered instrument (typo detector "
+                   "for the metrics namespace)")
+
+    # A suspect read must be at least this SequenceMatcher-close to a real
+    # name: genuine typos measure >=0.96, while foreign vmt_ strings
+    # (vmt_demo, vmt_xla_cache, native symbols) top out near 0.72.
+    _TYPO_CUTOFF = 0.85
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Set by the --changed driver: a subset scan cannot prove a name
+        # is unused *anywhere*, so the dead direction is suppressed there.
+        self.partial_scan = False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        audit = _instrument_audit(ctx.project)
+        registered = audit["registered"]
+        if not self.partial_scan:
+            for name, sites in sorted(registered.items()):
+                if name in audit["alive"]:
+                    continue
+                for node, rel, kind in sites:
+                    if rel != ctx.rel_path:
+                        continue
+                    yield self.finding(
+                        ctx, node,
+                        f"{kind} `{name}` is registered but nothing ever "
+                        f"writes to it or references it by name — a dead "
+                        f"instrument that every exposition still renders; "
+                        f"wire an observation to it or delete it")
+        import difflib
+
+        for rel, node, token in audit["suspect_reads"]:
+            if rel != ctx.rel_path:
+                continue
+            close = difflib.get_close_matches(
+                token, sorted(registered), n=2, cutoff=self._TYPO_CUTOFF)
+            if not close:
+                continue  # foreign vmt_ string, not the metrics namespace
+            yield self.finding(
+                ctx, node,
+                f"`{token}` matches no registered instrument (did you "
+                f"mean {' or '.join(close)}?) — a name-string read fails "
+                f"silently: the key is absent, the asserted exposition "
+                f"line can never exist")
+
+
+_INSTRUMENT_KINDS = ("counter", "gauge", "histogram")
+_METRIC_TOKEN_RE = re.compile(r"vmt_[a-z0-9_]+")
+
+
+def _canon_metric(token: str, registered) -> Optional[str]:
+    """The base instrument a name-string denotes, or None if unknown.
+    Handles Prometheus exposition suffixes and the Sampler's derived
+    rate keys (``X_total`` -> ``X_per_s``)."""
+    if token in registered:
+        return token
+    for suf in ("_bucket", "_sum", "_count"):
+        if token.endswith(suf) and token[: -len(suf)] in registered:
+            return token[: -len(suf)]
+    if token.endswith("_per_s"):
+        base = token[: -len("_per_s")] + "_total"
+        if base in registered:
+            return base
+    if token.endswith("_"):
+        # f-string prefix part (f"vmt_foo_{x}"): dynamic suffix — credit
+        # every instrument it could expand to, never a typo suspect.
+        for name in registered:
+            if name.startswith(token):
+                return name
+    return None
+
+
+def _instrument_audit(project) -> Dict:
+    """Cross-module instrument audit, cached on the ProjectGraph."""
+    cached = getattr(project, "_instrument_audit", None)
+    if cached is not None:
+        return cached
+    # name -> [(registration node, rel_path, kind)]
+    registered: Dict[str, List[Tuple[ast.AST, str, str]]] = {}
+    # Write/use evidence, gathered per direction below.
+    chained: Set[str] = set()            # REGISTRY.counter("x").inc()
+    bindings: Dict[str, Set[str]] = {}   # metric name -> bound identifiers
+    loaded: Set[str] = set()             # identifiers loaded anywhere
+    string_reads: List[Tuple[str, ast.AST, str]] = []  # (rel, node, token)
+
+    for mod in project.modules.values():
+        tree = mod.ctx.tree
+        reg_calls: Dict[int, str] = {}   # id(Call) -> metric name
+        reg_args: Set[int] = set()       # id(Constant) of registration names
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _INSTRUMENT_KINDS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("vmt_")):
+                name = node.args[0].value
+                registered.setdefault(name, []).append(
+                    (node, mod.ctx.rel_path, node.func.attr))
+                reg_calls[id(node)] = name
+                reg_args.add(id(node.args[0]))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                if id(node.value) in reg_calls:
+                    chained.add(reg_calls[id(node.value)])
+                if isinstance(node.ctx, ast.Load):
+                    loaded.add(node.attr)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                           ast.Load):
+                loaded.add(node.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    loaded.add(alias.asname or alias.name.split(".")[0])
+            elif isinstance(node, ast.Assign) and id(node.value) in reg_calls:
+                targets = bindings.setdefault(reg_calls[id(node.value)],
+                                              set())
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        targets.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        targets.add(t.attr)
+            elif (isinstance(node, ast.AnnAssign) and node.value is not None
+                    and id(node.value) in reg_calls
+                    and isinstance(node.target, ast.Name)):
+                bindings.setdefault(reg_calls[id(node.value)],
+                                    set()).add(node.target.id)
+            elif (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and id(node) not in reg_args
+                    and "vmt_" in node.value):
+                for token in _METRIC_TOKEN_RE.findall(node.value):
+                    string_reads.append((mod.ctx.rel_path, node, token))
+
+    alive: Set[str] = set(chained)
+    for name, idents in bindings.items():
+        # A bound handle counts as used when its identifier is loaded
+        # anywhere in the project — local increments, `from obs import
+        # SHED_COUNTER`, `self._errors.inc()` all qualify. Identifier-
+        # level (not scope-aware) on purpose: generous beats false drift.
+        if idents & loaded:
+            alive.add(name)
+    suspects: List[Tuple[str, ast.AST, str]] = []
+    seen: Set[Tuple[int, str]] = set()
+    for rel, node, token in string_reads:
+        canon = _canon_metric(token, registered)
+        if canon is not None:
+            alive.add(canon)
+        elif (id(node), token) not in seen:
+            seen.add((id(node), token))
+            suspects.append((rel, node, token))
+    audit = {"registered": registered, "alive": alive,
+             "suspect_reads": suspects}
+    project._instrument_audit = audit
+    return audit
+
+
 from vilbert_multitask_tpu.analysis.locks import (  # noqa: E402
     JitClosureCapture, LockOrderInversion, WaitHoldingForeignLock)
 
@@ -1817,7 +2001,7 @@ RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer,
          BlockingCallUnderSchedulerLock, ReplicaAffinityLeak,
          DequantOutsideJit, LockOrderInversion, WaitHoldingForeignLock,
-         JitClosureCapture, ConfigKnobDrift]
+         JitClosureCapture, ConfigKnobDrift, InstrumentNameDrift]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

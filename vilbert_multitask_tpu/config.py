@@ -527,6 +527,18 @@ class ServingConfig:
     recorder_max_bytes: int = 1_000_000
     recorder_spans: int = 256
     recorder_min_interval_s: float = 30.0
+    # Fleet observability spine (obs/fleet.py): every process's sampler
+    # tick flushes instrument snapshots, timeseries deltas, spans, and a
+    # heartbeat into a shared WAL sqlite db (next to the queue db when
+    # unset), so any process can answer ?scope=fleet queries for the
+    # whole fleet. A peer whose heartbeat is older than the staleness
+    # bound is treated as dead (SIGKILL leaves no tombstone).
+    fleet_enabled: bool = True
+    fleet_db_path: str | None = None
+    fleet_heartbeat_stale_s: float = 15.0
+    fleet_max_spans: int = 2048
+    fleet_spans_per_flush: int = 256
+    fleet_timeseries_window_s: float = 600.0
 
 
 @dataclasses.dataclass(frozen=True)

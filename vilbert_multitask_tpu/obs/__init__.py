@@ -69,6 +69,22 @@ from vilbert_multitask_tpu.obs.slo import (
     latency_slo,
     slack_floor_slo,
 )
+from vilbert_multitask_tpu.obs.identity import (
+    WorkerIdentity,
+    mint_identity,
+    process_identity,
+    reset_process_identity,
+)
+from vilbert_multitask_tpu.obs.fleet import (
+    FleetSpine,
+    default_spine_path,
+)
+from vilbert_multitask_tpu.obs.ledger import (
+    append_entry as ledger_append,
+    check as ledger_check,
+    default_ledger_path,
+    read_entries as ledger_entries,
+)
 
 __all__ = [
     "Span", "Tracer", "current_trace_id", "default_tracer", "new_trace_id",
@@ -85,6 +101,11 @@ __all__ = [
     "clear_recorder", "install_recorder", "record_event", "record_spike",
     "STATE_OK", "STATE_PAGE", "STATE_WARN", "Slo", "SloEvaluator",
     "availability_slo", "latency_slo", "slack_floor_slo",
+    "WorkerIdentity", "mint_identity", "process_identity",
+    "reset_process_identity",
+    "FleetSpine", "default_spine_path",
+    "ledger_append", "ledger_check", "ledger_entries",
+    "default_ledger_path",
 ]
 
 SPAN_HISTOGRAM = REGISTRY.histogram(

@@ -79,13 +79,18 @@ def render_prometheus(registry: Optional[Registry] = None,
     """The whole registry in Prometheus text exposition format.
 
     ``extra`` appends instruments living outside the registry (e.g. the
-    per-``Metrics``-instance request-latency histogram).
+    per-``Metrics``-instance request-latency histogram). The registry's
+    default labels (process identity, set by ``Registry.set_default_labels``)
+    are merged into every sample line here — exposition is the one place
+    identity stamping happens, so observe-time call sites stay unchanged.
     """
     registry = registry if registry is not None else REGISTRY
+    defaults = list(registry.default_labels().items())
     lines: List[str] = []
     for inst in sorted(registry.instruments() + list(extra),
                        key=lambda i: i.name):
         name = _metric_name(inst.name)
+        base = [(k, v) for k, v in defaults if k not in inst.labelnames]
         if inst.help:
             lines.append(f"# HELP {name} {_escape_help(inst.help)}")
         lines.append(f"# TYPE {name} {inst.kind}")
@@ -94,16 +99,16 @@ def render_prometheus(registry: Optional[Registry] = None,
                 for bound, cumulative in series["buckets"]:
                     lines.append(
                         f"{name}_bucket"
-                        f"{_labels(inst.labelnames, key, [('le', _fmt(bound))])}"
+                        f"{_labels(inst.labelnames, key, [('le', _fmt(bound))] + base)}"
                         f" {cumulative}")
-                lines.append(f"{name}_sum{_labels(inst.labelnames, key)} "
+                lines.append(f"{name}_sum{_labels(inst.labelnames, key, base)} "
                              f"{_fmt(series['sum'])}")
-                lines.append(f"{name}_count{_labels(inst.labelnames, key)} "
+                lines.append(f"{name}_count{_labels(inst.labelnames, key, base)} "
                              f"{series['count']}")
         else:
             for key, value in sorted(inst.collect().items()):
                 lines.append(
-                    f"{name}{_labels(inst.labelnames, key)} {_fmt(value)}")
+                    f"{name}{_labels(inst.labelnames, key, base)} {_fmt(value)}")
     return "\n".join(lines) + "\n"
 
 

@@ -272,6 +272,21 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: Dict[str, _Instrument] = {}
+        self._default_labels: Dict[str, str] = {}
+
+    # -------------------------------------------------------- default labels
+    def set_default_labels(self, **labels: str) -> None:
+        """Label pairs stamped onto EVERY sample at exposition time
+        (process identity: ``instance``, ``role``). Applied by the
+        renderer, not at observe time — instruments keep their declared
+        label sets, so ``_key`` validation and cross-process merge code
+        see unchanged schemas. Call with no kwargs to clear."""
+        with self._lock:
+            self._default_labels = {k: str(v) for k, v in labels.items()}
+
+    def default_labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._default_labels)
 
     def _get(self, cls, name: str, help: str,
              labelnames: Sequence[str], **kwargs):

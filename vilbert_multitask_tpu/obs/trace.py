@@ -159,6 +159,7 @@ class Tracer:
         self._ring: deque = deque(maxlen=max_spans)
         self._tls = threading.local()
         self._observer: Optional[Callable[[Span], None]] = None
+        self._default_attrs: Dict[str, Any] = {}
         # Monotonic epoch: exporters place span starts relative to this
         # (Chrome-trace ts must be small positive µs, not raw perf_counter).
         self.epoch_perf = time.perf_counter()
@@ -184,6 +185,12 @@ class Tracer:
     def set_observer(self, fn: Optional[Callable[[Span], None]]) -> None:
         """Called with every completed span (metrics bridging). One slot."""
         self._observer = fn
+
+    def set_default_attrs(self, **attrs: Any) -> None:
+        """Attributes merged into every recorded span (process identity —
+        how a stitched fleet trace tells submitter spans from worker
+        spans). Span-local attrs win on collision; no kwargs clears."""
+        self._default_attrs = dict(attrs)
 
     # ------------------------------------------------------------- recording
     def span(self, name: str, **attrs):
@@ -218,6 +225,8 @@ class Tracer:
                           dict(attrs)))
 
     def _record(self, span: Span) -> None:
+        if self._default_attrs:
+            span.attrs = {**self._default_attrs, **span.attrs}
         with self._lock:
             self._ring.append(span)
         observer = self._observer

@@ -27,6 +27,10 @@ from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge
 from vilbert_multitask_tpu.serve.queue import DurableQueue
 from vilbert_multitask_tpu.serve.worker import ServeWorker
 
+_FLEET_FLUSH_ERRORS = obs.REGISTRY.counter(
+    "vmt_fleet_flush_errors_total",
+    "Sampler ticks whose fleet-spine flush failed (local tick unaffected).")
+
 
 class ServeApp:
     def __init__(self, cfg: Optional[FrameworkConfig] = None, *,
@@ -147,6 +151,21 @@ class ServeApp:
         self.sampler = obs.Sampler(self.timeseries, self._sample,
                                    cadence_s=s.sampler_cadence_s)
         self.fingerprint = config_fingerprint(self.cfg)
+        # Fleet observability: this process's identity plus its handle on
+        # the shared metrics spine (a WAL sqlite next to the queue db).
+        # Every sampler tick flushes instruments/timeseries/spans/heartbeat
+        # there; ?scope=fleet queries on any peer merge them back.
+        self.identity = obs.process_identity("serve")
+        self.fleet: Optional[obs.FleetSpine] = None
+        if s.fleet_enabled:
+            self.fleet = obs.FleetSpine(
+                s.fleet_db_path or obs.default_spine_path(s.queue_db_path),
+                self.identity,
+                heartbeat_stale_s=s.fleet_heartbeat_stale_s,
+                max_spans_per_ident=s.fleet_max_spans,
+                spans_per_flush=s.fleet_spans_per_flush,
+                timeseries_window_s=s.fleet_timeseries_window_s,
+                timeseries=self.timeseries)
         rec_dir = s.recorder_dir
         if rec_dir == "serve_state/postmortem":
             # Default follows the queue db (tests and the soak point that
@@ -162,13 +181,16 @@ class ServeApp:
                 "timeseries": self.timeseries.snapshot,
                 "config_fingerprint": lambda: self.fingerprint,
                 "boot_info": lambda: dict(self.boot_info),
+                "identity": self.identity.as_dict,
+                "fleet": lambda: (self.fleet.snapshot()
+                                  if self.fleet is not None else {}),
             })
         self.api = ApiServer(
             self.queue, self.store, self.hub, s,
             metrics=self.worker.metrics, boot_info=self.boot_info,
             stats_fn=lambda: {"input_cache": self.engine.input_cache_stats},
             slos=self.slos, timeseries=self.timeseries,
-            pool=self.engine, swap_fn=self.rolling_swap)
+            pool=self.engine, swap_fn=self.rolling_swap, fleet=self.fleet)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
@@ -234,6 +256,15 @@ class ServeApp:
         worst = self.slos.worst_state()
         vals["slo_worst"] = float(
             {"ok": 0, "warn": 1, "page": 2}.get(worst, 0))
+        # Publish this tick to the fleet spine (heartbeat + instrument
+        # snapshots + timeseries deltas + fresh spans). Isolated failure
+        # domain: a locked/corrupt spine db must not cost the LOCAL tick.
+        if self.fleet is not None:
+            try:
+                self.fleet.flush({"phase": self.boot_info.get("phase"),
+                                  "slo_worst": worst})
+            except Exception:  # noqa: BLE001
+                _FLEET_FLUSH_ERRORS.inc()
         return vals
 
     def warm(self) -> None:
@@ -312,6 +343,15 @@ class ServeApp:
               param_dtype=self.cfg.engine.param_dtype,
               config_fingerprint=self.fingerprint)
         self.boot_info["config_fingerprint"] = self.fingerprint
+        self.boot_info["identity"] = self.identity.as_dict()
+        # Process-identity stamping: every exposition sample gains
+        # instance/role labels (merged at render time, so instrument
+        # schemas and observe calls are untouched), and every span gains
+        # matching attrs — the fleet merge's join keys. stop() clears
+        # both (the registry/tracer are process globals).
+        obs.REGISTRY.set_default_labels(**self.identity.labels())
+        obs.default_tracer().set_default_attrs(
+            instance=self.identity.ident, role=self.identity.role)
         # The flight recorder goes live before any tier can trip it.
         obs.install_recorder(self.recorder)
         # Websocket first: /config must never advertise an unbound ws port
@@ -330,6 +370,13 @@ class ServeApp:
             self._worker_thread.start()
         self.sampler.start()
         self.boot_info["phase"] = "ready"
+        # First heartbeat immediately: peers must see this process in
+        # ?scope=fleet without waiting out a sampler cadence.
+        if self.fleet is not None:
+            try:
+                self.fleet.flush({"phase": "ready"})
+            except Exception:  # noqa: BLE001
+                _FLEET_FLUSH_ERRORS.inc()
 
     def stop(self) -> None:
         """Graceful drain: signal the worker to stop CLAIMING, give it
@@ -351,6 +398,17 @@ class ServeApp:
         self.api.stop()
         self.ws.stop()
         self.sampler.stop()
+        # Withdraw from the fleet (heartbeat/instruments/timeseries rows;
+        # spans stay stitchable) and un-stamp the process-global registry
+        # and tracer — other apps in this process must not inherit a dead
+        # incarnation's identity labels.
+        if self.fleet is not None:
+            try:
+                self.fleet.retire()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                _FLEET_FLUSH_ERRORS.inc()
+        obs.REGISTRY.set_default_labels()
+        obs.default_tracer().set_default_attrs()
         # Uninstall only our own recorder (another app may have replaced
         # it); close() drains queued triggers and joins the writer thread.
         if obs.active_recorder() is self.recorder:

@@ -53,6 +53,7 @@ class ApiServer:
         timeseries=None,
         pool=None,
         swap_fn=None,
+        fleet=None,
     ):
         self.queue = queue
         self.store = store
@@ -76,6 +77,11 @@ class ApiServer:
         # rolling checkpoint swap).
         self.pool = pool
         self.swap_fn = swap_fn
+        # Fleet spine (obs/fleet.py, ServeApp wires it): ?scope=fleet on
+        # /metrics, /debug/timeseries, /healthz merges every live peer
+        # sharing the spine db, and /debug/trace?trace_id= stitches one
+        # timeline across processes.
+        self.fleet = fleet
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -213,6 +219,7 @@ class ApiServer:
         ready = not booting and not paging and not no_replica
         body: Dict[str, Any] = {
             "ok": ready,
+            "identity": obs.process_identity().as_dict(),
             "queue": self.queue.counts(),
             "boot": self.boot_info,
             "breakers": breakers,
@@ -344,14 +351,32 @@ class ApiServer:
                     self._json(200, {"rows": rows})
                 elif path.startswith("/attention/"):
                     self._serve_attention(path)
-                elif path == "/healthz":
-                    self._json(*api.health())
-                elif path == "/metrics" or path.startswith("/metrics?"):
+                elif path == "/healthz" or path.startswith("/healthz?"):
                     # NB: ``path`` retains the query string (rstrip only
                     # trims slashes), hence the startswith branch.
                     from urllib.parse import parse_qs, urlsplit
 
                     q = parse_qs(urlsplit(self.path).query)
+                    if q.get("scope", [""])[0] == "fleet":
+                        if api.fleet is None:
+                            self._json(503, {"error": "no fleet spine "
+                                                      "configured"})
+                            return
+                        fleet = api.fleet.health()
+                        self._json(200 if fleet["fleet_ready"] else 503,
+                                   fleet)
+                        return
+                    self._json(*api.health())
+                elif path == "/metrics" or path.startswith("/metrics?"):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    if q.get("scope", [""])[0] == "fleet":
+                        # Fleet scope is always a scrape: merged Prometheus
+                        # text across live peers (counters summed, gauges
+                        # per-identity, histograms bucket-merged).
+                        self._serve_fleet_prometheus()
+                        return
                     if q.get("format", [""])[0] == "prometheus":
                         self._serve_prometheus()
                         return
@@ -380,9 +405,6 @@ class ApiServer:
                     })
                 elif (path == "/debug/timeseries"
                       or path.startswith("/debug/timeseries?")):
-                    if api.timeseries is None:
-                        self._json(200, {"enabled": False, "series": {}})
-                        return
                     from urllib.parse import parse_qs, urlsplit
 
                     q = parse_qs(urlsplit(self.path).query)
@@ -390,6 +412,18 @@ class ApiServer:
                         window = float(q.get("window_s", ["0"])[0]) or None
                     except ValueError:
                         window = None
+                    if q.get("scope", [""])[0] == "fleet":
+                        if api.fleet is None:
+                            self._json(200, {"enabled": False,
+                                             "scope": "fleet", "series": {}})
+                            return
+                        body = api.fleet.timeseries(window)
+                        body["enabled"] = True
+                        self._json(200, body)
+                        return
+                    if api.timeseries is None:
+                        self._json(200, {"enabled": False, "series": {}})
+                        return
                     self._json(200, {
                         "enabled": True,
                         "series": api.timeseries.snapshot(window),
@@ -402,6 +436,26 @@ class ApiServer:
                         limit = int(q.get("limit", ["0"])[0]) or None
                     except ValueError:
                         limit = None
+                    trace_id = q.get("trace_id", [""])[0] or None
+                    fleet_scope = (q.get("scope", [""])[0] == "fleet"
+                                   or trace_id is not None)
+                    if fleet_scope and api.fleet is not None:
+                        # Export this process's freshest spans first so a
+                        # trace queried right after completion stitches
+                        # without waiting out a sampler tick.
+                        try:
+                            api.fleet.flush()
+                        except Exception:  # noqa: BLE001 — serve what's there
+                            obs.REGISTRY.counter(
+                                "vmt_fleet_flush_errors_total").inc()
+                        self._json(200, api.fleet.chrome_trace(
+                            trace_id, limit=limit))
+                        return
+                    if trace_id is not None:
+                        spans = [s for s in obs.default_tracer().spans()
+                                 if s.trace_id == trace_id]
+                        self._json(200, obs.chrome_trace(spans=spans))
+                        return
                     self._json(200, obs.chrome_trace(limit=limit))
                 else:
                     self._json(404, {"error": "not found"})
@@ -415,7 +469,24 @@ class ApiServer:
                 extra = ([api.metrics.latency]
                          if api.metrics is not None
                          and hasattr(api.metrics, "latency") else [])
-                body = obs.render_prometheus(extra=extra).encode()
+                self._send_prometheus(obs.render_prometheus(extra=extra))
+
+            def _serve_fleet_prometheus(self) -> None:
+                if api.fleet is None:
+                    self._json(503, {"error": "no fleet spine configured"})
+                    return
+                # Refresh local gauges and push them to the spine so the
+                # answering process is never staler than its own scrape.
+                api.refresh_gauges()
+                try:
+                    api.fleet.flush()
+                except Exception:  # noqa: BLE001 — merge what peers wrote
+                    obs.REGISTRY.counter(
+                        "vmt_fleet_flush_errors_total").inc()
+                self._send_prometheus(api.fleet.render_prometheus())
+
+            def _send_prometheus(self, text: str) -> None:
+                body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  obs.PROMETHEUS_CONTENT_TYPE)
@@ -658,8 +729,11 @@ class ApiServer:
                     return
                 try:
                     if path == "/worker/claim":
+                        claimed_by = p.get("claimed_by") or None
                         job = api.queue.claim(
-                            exclude=[int(x) for x in p.get("exclude", [])])
+                            exclude=[int(x) for x in p.get("exclude", [])],
+                            claimed_by=(str(claimed_by)
+                                        if claimed_by else None))
                         self._json(200, {"job": None if job is None else {
                             "id": job.id, "body": job.body,
                             "attempts": job.attempts,

@@ -88,6 +88,11 @@ class DurableQueue:
                 # so exactly one consumer notifies the client.
                 c.execute("ALTER TABLE jobs ADD COLUMN "
                           "dead_notified INTEGER NOT NULL DEFAULT 0")
+            if "claimed_by" not in cols:
+                # Which process incarnation (WorkerIdentity.ident,
+                # host:pid:nonce) holds the in-flight claim — the queue-side
+                # half of fleet observability: a stuck job names its holder.
+                c.execute("ALTER TABLE jobs ADD COLUMN claimed_by TEXT")
 
     def _conn(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self.path, timeout=30.0)
@@ -107,12 +112,14 @@ class DurableQueue:
             return int(cur.lastrowid)
 
     # ---------------------------------------------------------------- consumer
-    def claim(self, exclude: Sequence[int] = ()) -> Optional[Job]:
+    def claim(self, exclude: Sequence[int] = (),
+              claimed_by: Optional[str] = None) -> Optional[Job]:
         """Atomically claim the oldest deliverable job (None if drained).
 
         ``exclude`` skips specific job ids for this call — the batch worker
         uses it so a failing job doesn't block or spin while its batchmates
-        drain.
+        drain. ``claimed_by`` stamps the claimer's process identity on the
+        row so introspection can name the holder of every in-flight job.
 
         Also sweeps expired in-flight claims back to pending — the embedded
         equivalent of a broker's visibility timeout, covering worker crashes
@@ -124,7 +131,8 @@ class DurableQueue:
         with self._conn() as c:
             c.execute("BEGIN IMMEDIATE")
             c.execute(
-                "UPDATE jobs SET status='pending', claimed_at=NULL "
+                "UPDATE jobs SET status='pending', claimed_at=NULL, "
+                "claimed_by=NULL "
                 "WHERE queue=? AND status='inflight' AND claimed_at < ?",
                 # Deadline math on persisted wall-clock stamps: claimed_at is
                 # written by (possibly) another process, so a monotonic clock
@@ -165,8 +173,9 @@ class DurableQueue:
             job_id, body, attempts, deliveries = row
             c.execute(
                 "UPDATE jobs SET status='inflight', attempts=attempts+1, "
-                "delivery_count=delivery_count+1, claimed_at=? WHERE id=?",
-                (now, job_id),
+                "delivery_count=delivery_count+1, claimed_at=?, "
+                "claimed_by=? WHERE id=?",
+                (now, claimed_by, job_id),
             )
         if poisoned:
             obs.POISON_COUNTER.inc(poisoned)
@@ -195,7 +204,7 @@ class DurableQueue:
             # (worker._fail_job) — mark notified so pop_dead_letters()
             # never double-pushes for this row.
             c.execute(
-                "UPDATE jobs SET status=?, claimed_at=NULL, "
+                "UPDATE jobs SET status=?, claimed_at=NULL, claimed_by=NULL, "
                 "dead_notified=? WHERE id=?",
                 (status, 1 if status == "dead" else 0, job_id),
             )
@@ -209,7 +218,8 @@ class DurableQueue:
         with self._conn() as c:
             c.execute(
                 "UPDATE jobs SET status='pending', claimed_at=NULL, "
-                "attempts=MAX(attempts-1, 0) WHERE id=? AND status='inflight'",
+                "claimed_by=NULL, attempts=MAX(attempts-1, 0) "
+                "WHERE id=? AND status='inflight'",
                 (job_id,),
             )
 
@@ -222,6 +232,24 @@ class DurableQueue:
                 (self.queue_name,),
             ).fetchall()
         return {status: n for status, n in rows}
+
+    def inflight_claims(self) -> list[Dict[str, Any]]:
+        """Who holds what: each in-flight job's id, holder identity, and
+        claim age — the fleet-health answer to "is this job stuck, and on
+        which process"."""
+        with self._conn() as c:
+            rows = c.execute(
+                "SELECT id, claimed_by, claimed_at FROM jobs "
+                "WHERE queue=? AND status='inflight' ORDER BY id",
+                (self.queue_name,),
+            ).fetchall()
+        # Persisted wall stamps, possibly from another process (same
+        # rationale as oldest_pending_age_s).
+        now = time.time()
+        return [{"id": i, "claimed_by": by,
+                 "age_s": (round(max(0.0, now - at), 3)  # vmtlint: disable=VMT109
+                           if at is not None else None)}
+                for i, by, at in rows]
 
     def oldest_pending_age_s(self) -> Optional[float]:
         """Age of the oldest pending job (None when the queue is empty) —

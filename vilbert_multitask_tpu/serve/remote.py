@@ -105,9 +105,12 @@ class RemoteQueue:
     def __init__(self, client: WorkerApiClient):
         self._c = client
 
-    def claim(self, exclude: Sequence[int] = ()) -> Optional[Job]:
+    def claim(self, exclude: Sequence[int] = (),
+              claimed_by: Optional[str] = None) -> Optional[Job]:
         try:
-            out = self._c.post("/worker/claim", {"exclude": list(exclude)})
+            out = self._c.post("/worker/claim",
+                               {"exclude": list(exclude),
+                                "claimed_by": claimed_by})
         except _NET_ERRORS as e:
             log.warning("claim unreachable (%s); treating as drained", e)
             return None
@@ -245,6 +248,15 @@ def main(argv=None) -> None:
     p.add_argument("--no-warmup", action="store_true")
     args = p.parse_args(argv)
 
+    # This process is its own fleet incarnation: mint the identity and
+    # stamp exposition samples and spans, mirroring ServeApp.start().
+    # Claims this worker posts carry the same ident in claimed_by.
+    from vilbert_multitask_tpu import obs
+
+    identity = obs.process_identity("remote-worker")
+    obs.REGISTRY.set_default_labels(**identity.labels())
+    obs.default_tracer().set_default_attrs(
+        instance=identity.ident, role=identity.role)
     worker = build_remote_worker(
         args.url, feature_root=args.features,
         checkpoint_path=args.checkpoint, token=args.token)

@@ -183,12 +183,13 @@ class ServeWorker:
         joins the claimed job's trace after the fact (record_span)."""
         t0 = time.perf_counter()
         self._notify_dead_letters()
-        job = self.queue.claim(exclude=exclude)
+        ident = obs.process_identity().ident
+        job = self.queue.claim(exclude=exclude, claimed_by=ident)
         if job is not None:
             obs.default_tracer().record_span(
                 "worker.claim", t0, time.perf_counter() - t0,
                 trace_id=job.body.get("trace_id"), job_id=job.id,
-                attempts=job.attempts)
+                attempts=job.attempts, claimed_by=ident)
             published = job.body.get("published_unix")
             if published is not None:
                 # Publish→claim latency. Wall-clock delta against the
@@ -229,6 +230,7 @@ class ServeWorker:
                  "error": "poison job dead-lettered after "
                           f"{job.deliveries} deliveries",
                  "dead_letter": True,
+                 "process": obs.process_identity().ident,
                  "question": job.body.get("question", "")})
 
     def _failover_job(self, job: Job, replica: str) -> str:
@@ -252,6 +254,7 @@ class ServeWorker:
                          "requeued on a healthy replica.",
              "requeued": True,
              "replica": replica,
+             "process": obs.process_identity().ident,
              "question": job.body.get("question", "")})
         return "requeued"
 
@@ -539,6 +542,7 @@ class ServeWorker:
                              "worker.",
                  "requeued": True,
                  "abandoned_by": replica,
+                 "process": obs.process_identity().ident,
                  "question": job.body.get("question", "")})
         return len(abandoned)
 

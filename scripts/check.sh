@@ -26,6 +26,19 @@ else
     || fail=1
 fi
 
+echo "== baseline hygiene (no stale suppressions ride along) =="
+# A baseline entry whose finding no longer fires is a dead suppression:
+# it hides any future finding with the same fingerprint. Fail fast here;
+# the fix is `--prune-baseline` (without --check) after reviewing.
+python -m vilbert_multitask_tpu.analysis --prune-baseline --check || fail=1
+
+echo "== compile surface (COMPILE_SURFACE.json vs the tree) =="
+# The committed manifest enumerates the AOT key universe (family x bucket
+# x param_dtype x fused x topology x attn). Drift means someone changed
+# the compile surface without regenerating the manifest — rerun
+# `python -m vilbert_multitask_tpu.analysis surface` and commit.
+python -m vilbert_multitask_tpu.analysis surface --check || fail=1
+
 if [[ "${1:-}" == "--lint" ]]; then
   exit "$fail"
 fi

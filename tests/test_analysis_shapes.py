@@ -1,0 +1,533 @@
+"""Shape-tier suite: the abstract domain, the interpreter, and the four
+shape rules (VMT124–VMT127) — each rule with a positive fixture (the
+hazard, minimally) AND a clean fixture (the correct idiom it must stay
+quiet on), same discipline as the rest of the vmtlint fixtures."""
+
+import ast
+import textwrap
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.core import analyze_project
+from vilbert_multitask_tpu.analysis.graph import ProjectGraph
+from vilbert_multitask_tpu.analysis.rules import default_rules
+from vilbert_multitask_tpu.analysis.shapes import (
+    DType,
+    Scalar,
+    Tree,
+    Tup,
+    interpret_function,
+    is_int8_pair,
+    jit_static_bindings,
+    join_values,
+    knob_table,
+    promote,
+    promotion_leak,
+)
+
+CONFIG_SRC = textwrap.dedent('''
+    class EngineConfig:
+        max_text_len: int = 37
+        max_regions: int = 101
+        image_buckets: tuple = (1, 2, 4, 8, 10)
+        throughput_buckets: tuple = (16, 32)
+        param_dtype: str = "float32"
+        fused_task_heads: bool = True
+
+    class MeshConfig:
+        dp: int = -1
+        tp: int = 1
+        sp: int = 1
+''')
+
+
+def _project(sources):
+    ctxs = [ModuleContext(p, s, ast.parse(s)) for p, s in sources.items()]
+    project = ProjectGraph(ctxs)
+    for c in ctxs:
+        c.project = project
+    return project, {c.rel_path: c for c in ctxs}
+
+
+def _scan(sources, rule_ids):
+    rules = [r for r in default_rules() if r.id in rule_ids]
+    return analyze_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        rules=rules, library_roots=("pkg",))
+
+
+# ------------------------------------------------------------ dtype lattice
+def test_promote_bf16_f16_widens_to_f32():
+    assert promote(DType("bfloat16"), DType("float16")).name == "float32"
+
+
+def test_promote_keeps_higher_float():
+    assert promote(DType("bfloat16"), DType("float32")).name == "float32"
+    assert promote(DType("float64"), DType("float32")).name == "float64"
+
+
+def test_weak_python_scalar_does_not_widen():
+    # x_bf16 * 2.0 stays bf16 — JAX weak typing.
+    out = promote(DType("bfloat16"), DType("float32", weak=True))
+    assert out.name == "bfloat16"
+
+
+def test_int_float_promotes_to_float():
+    assert promote(DType("int32"), DType("bfloat16")).name == "bfloat16"
+
+
+def test_promotion_leak_needs_default_ctor_provenance():
+    lo = DType("bfloat16")
+    assert promotion_leak(lo, DType("float32", ctor_line=7)) == (
+        "bfloat16", 7)
+    # Explicit f32 (no ctor provenance) is a deliberate cast — no leak.
+    assert promotion_leak(lo, DType("float32")) is None
+    # int8 storage meeting a default-ctor f32 leaks too.
+    assert promotion_leak(DType("int8"),
+                          DType("float32", ctor_line=3)) is not None
+
+
+def test_join_scalars_takes_worst_origin():
+    a = Scalar(4, "config", sym="EngineConfig.tp")
+    b = Scalar(None, "data")
+    j = join_values(a, b)
+    assert j.origin == "data" and j.value is None
+
+
+def test_int8_pair_detection():
+    pair = Tree((("int8", None), ("scale", None)))
+    assert is_int8_pair(pair)
+    assert not is_int8_pair(Tree((("int8", None), ("zero", None))))
+
+
+# -------------------------------------------------------------- knob table
+def test_knob_table_binds_literal_defaults():
+    project, _ = _project({"pkg/config.py": CONFIG_SRC})
+    knobs = knob_table(project)
+    assert knobs.field("max_text_len").value == 37
+    assert knobs.get("EngineConfig", "image_buckets").value == (1, 2, 4,
+                                                                8, 10)
+    assert knobs.get("MeshConfig", "dp").value == -1
+    # ints() flattens tuples: the shape vocabulary VMT127 judges against.
+    assert {16, 32, 37, 101} <= knobs.ints()
+
+
+def test_knob_table_poisons_ambiguous_field_names():
+    src = CONFIG_SRC + textwrap.dedent('''
+        class ServingConfig:
+            max_text_len: int = 99
+    ''')
+    project, _ = _project({"pkg/config.py": src})
+    assert knob_table(project).field("max_text_len") is None
+
+
+# ------------------------------------------------------------- interpreter
+def _interp(fn_src, fn_name, extra=None):
+    sources = {"pkg/config.py": CONFIG_SRC,
+               "pkg/mod.py": textwrap.dedent(fn_src)}
+    if extra:
+        sources.update(extra)
+    project, ctxs = _project(sources)
+    ctx = ctxs["pkg/mod.py"]
+    fn = next(n for n in ast.walk(ctx.tree)
+              if isinstance(n, ast.FunctionDef) and n.name == fn_name)
+    interp = interpret_function(ctx, fn, knob_table(project))
+    env = {}
+    for ev, fact in interp.iter_facts():
+        env = interp.transfer(ev, fact)
+    return interp, env
+
+
+def test_interpreter_binds_config_knob_with_witness():
+    _, env = _interp('''
+        def f(cfg):
+            b = cfg.engine.max_text_len
+            return b
+    ''', "f")
+    b = env["b"]
+    assert b.value == 37 and b.origin == "config"
+    assert b.sym == "EngineConfig.max_text_len"
+    assert b.witness and b.witness[0][0] == "pkg/config.py"
+
+
+def test_interpreter_tracks_ctor_shape_and_dtype():
+    _, env = _interp('''
+        import jax.numpy as jnp
+        def f(cfg):
+            x = jnp.zeros((cfg.engine.max_text_len, 5), jnp.bfloat16)
+            return x
+    ''', "f")
+    x = env["x"]
+    assert x.rank == 2
+    assert x.shape[0].value == 37 and x.shape[1].value == 5
+    assert x.dtype.name == "bfloat16" and x.dtype.ctor_line == 0
+
+
+def test_interpreter_positional_dtype_argument():
+    # The repo idiom: jnp.zeros((n, 5), jnp.float32) — dtype positional.
+    _, env = _interp('''
+        import jax.numpy as jnp
+        def f():
+            x = jnp.zeros((4, 5), jnp.float32)
+            y = jnp.zeros((4, 5))
+            return x, y
+    ''', "f")
+    assert env["x"].dtype.ctor_line == 0  # explicit — never a leak source
+    assert env["y"].dtype.ctor_line > 0  # defaulted — leak provenance
+
+
+def test_interpreter_loop_target_binds_bucket_elements():
+    _, env = _interp('''
+        def f(cfg):
+            for b in cfg.engine.all_row_buckets():
+                last = b
+            return last
+    ''', "f")
+    last = env["last"]
+    assert last.origin == "bucket"
+    assert last.sym == "EngineConfig.all_row_buckets"
+
+
+def test_interpreter_len_of_param_is_data_origin():
+    _, env = _interp('''
+        def f(rows):
+            n = len(rows)
+            return n
+    ''', "f")
+    assert env["n"].origin == "data"
+
+
+def test_interpreter_bucketizer_rebounds_data():
+    _, env = _interp('''
+        def f(cfg, rows):
+            b = cfg.engine.row_bucket_for(len(rows))
+            return b
+    ''', "f")
+    assert env["b"].origin == "bucket"
+
+
+def test_interpreter_tuple_destructuring_and_shape_attr():
+    _, env = _interp('''
+        import jax.numpy as jnp
+        def f():
+            x = jnp.zeros((3, 7), jnp.float32)
+            a, b = x.shape
+            return a, b
+    ''', "f")
+    assert env["a"].value == 3 and env["b"].value == 7
+
+
+def test_interpreter_folds_scalar_arithmetic():
+    _, env = _interp('''
+        def f(cfg):
+            n = cfg.engine.max_text_len + 1
+            return n
+    ''', "f")
+    assert env["n"].value == 38 and env["n"].origin == "config"
+
+
+def test_interpreter_join_over_branches():
+    _, env = _interp('''
+        def f(cfg, flag):
+            if flag:
+                b = 1
+            else:
+                b = cfg.engine.max_text_len
+            return b
+    ''', "f")
+    # Values differ → unknown value; origin is the worse of the two.
+    assert env["b"].value is None and env["b"].origin == "config"
+
+
+def test_jit_static_bindings_both_forms():
+    src = textwrap.dedent('''
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def decorated(x, n):
+            return x
+
+        def impl(x, m):
+            return x
+
+        wrapped = jax.jit(impl, static_argnames=("m",))
+    ''')
+    ctx = ModuleContext("pkg/m.py", src, ast.parse(src))
+    bindings = jit_static_bindings(ctx)
+    assert bindings["decorated"].static_names == ("n",)
+    assert bindings["wrapped"].static_names == ("m",)
+    assert bindings["wrapped"].params == ("x", "m")
+
+
+def test_eval_tup_concat_and_subscript():
+    _, env = _interp('''
+        def f(cfg):
+            dims = (cfg.engine.max_text_len,) + (5,)
+            d0 = dims[0]
+            return d0
+    ''', "f")
+    assert isinstance(env["dims"], Tup) and len(env["dims"].elts) == 2
+    assert env["d0"].value == 37
+
+
+# ----------------------------------------------------------------- VMT124
+V124_POSITIVE = {
+    "pkg/config.py": CONFIG_SRC,
+    "pkg/engine.py": '''
+        import jax
+
+        def _impl(pack, n):
+            return pack
+
+        fwd = jax.jit(_impl, static_argnames=("n",))
+
+        def dispatch(rows):
+            n = len(rows)
+            return fwd(rows, n)
+    ''',
+}
+
+V124_CLEAN = {
+    "pkg/config.py": CONFIG_SRC,
+    "pkg/engine.py": '''
+        import jax
+
+        def _impl(pack, n):
+            return pack
+
+        fwd = jax.jit(_impl, static_argnames=("n",))
+
+        def dispatch(cfg, rows):
+            b = cfg.engine.row_bucket_for(len(rows))
+            return fwd(rows, b)
+
+        def warm(cfg, rows):
+            for b in cfg.engine.all_row_buckets():
+                fwd(rows, b)
+    ''',
+}
+
+
+def test_vmt124_flags_data_dependent_static_arg():
+    found = [f for f in _scan(V124_POSITIVE, {"VMT124"})
+             if f.rule == "VMT124"]
+    assert len(found) == 1
+    f = found[0]
+    assert "static argument `n`" in f.message
+    # Witness chain ends at the call site, starts at the data source.
+    assert f.flows and f.flows[0][-1]["message"].startswith(
+        "flows into static arg")
+
+
+def test_vmt124_clean_when_bucketized_or_enumerated():
+    assert not [f for f in _scan(V124_CLEAN, {"VMT124"})
+                if f.rule == "VMT124"]
+
+
+def test_vmt124_literal_static_arg_is_clean():
+    sources = {
+        "pkg/engine.py": '''
+            import jax
+
+            @jax.jit
+            def outer(pack):
+                return pack
+
+            def _impl(pack, n):
+                return pack
+
+            fwd = jax.jit(_impl, static_argnames=("n",))
+
+            def dispatch(rows):
+                return fwd(rows, 4)
+        ''',
+    }
+    assert not [f for f in _scan(sources, {"VMT124"})
+                if f.rule == "VMT124"]
+
+
+# ----------------------------------------------------------------- VMT125
+V125_POSITIVE = {
+    "pkg/model.py": '''
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            acc = jnp.zeros((4, 4))
+            lo = jnp.ones((4, 4), jnp.bfloat16)
+            return acc + lo
+    ''',
+}
+
+V125_CLEAN = {
+    "pkg/model.py": '''
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            acc = jnp.zeros((4, 4), jnp.bfloat16)
+            explicit = jnp.zeros((4, 4), jnp.float32)
+            scaled = acc * 2.0
+            return acc + explicit + scaled
+    ''',
+}
+
+
+def test_vmt125_flags_default_ctor_promotion():
+    found = [f for f in _scan(V125_POSITIVE, {"VMT125"})
+             if f.rule == "VMT125"]
+    assert len(found) == 1
+    assert "bfloat16" in found[0].message
+    assert found[0].flows  # ctor step + promotion step
+    assert len(found[0].flows[0]) == 2
+
+
+def test_vmt125_clean_on_explicit_dtypes_and_weak_scalars():
+    assert not [f for f in _scan(V125_CLEAN, {"VMT125"})
+                if f.rule == "VMT125"]
+
+
+def test_vmt125_reports_root_not_cascade():
+    sources = {
+        "pkg/model.py": '''
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                acc = jnp.zeros((4, 4))
+                lo = jnp.ones((4, 4), jnp.bfloat16)
+                bad = acc + lo
+                more = jnp.ones((4, 4), jnp.bfloat16)
+                return bad + more
+        ''',
+    }
+    found = [f for f in _scan(sources, {"VMT125"}) if f.rule == "VMT125"]
+    # One root cause, one finding — the widened result must not
+    # re-report at every downstream use.
+    assert len(found) == 1
+
+
+def test_vmt125_covers_traced_helpers_cross_module():
+    sources = {
+        "pkg/model.py": '''
+            import jax.numpy as jnp
+
+            def helper(x):
+                acc = jnp.zeros((4, 4))
+                lo = jnp.ones((4, 4), jnp.bfloat16)
+                return acc + lo
+        ''',
+        "pkg/engine.py": '''
+            import jax
+            from pkg.model import helper
+
+            @jax.jit
+            def fwd(x):
+                return helper(x)
+        ''',
+    }
+    found = [f for f in _scan(sources, {"VMT125"}) if f.rule == "VMT125"]
+    assert len(found) == 1 and found[0].path == "pkg/model.py"
+
+
+# ----------------------------------------------------------------- VMT126
+V126_POSITIVE = {
+    "pkg/parallel.py": '''
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        def constrain(x):
+            y = jnp.zeros((4, 8), jnp.float32)
+            return jax.lax.with_sharding_constraint(y, P("dp", "tp", "sp"))
+    ''',
+}
+
+V126_CLEAN = {
+    "pkg/parallel.py": '''
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        def constrain(x):
+            y = jnp.zeros((4, 8), jnp.float32)
+            a = jax.lax.with_sharding_constraint(y, P("dp", "tp"))
+            b = jax.lax.with_sharding_constraint(y, P("dp"))
+            c = jax.lax.with_sharding_constraint(x, P("dp", "tp", "sp"))
+            return a, b, c
+    ''',
+}
+
+
+def test_vmt126_flags_overlong_partition_spec():
+    found = [f for f in _scan(V126_POSITIVE, {"VMT126"})
+             if f.rule == "VMT126"]
+    assert len(found) == 1
+    assert "3 axes" in found[0].message and "rank 2" in found[0].message
+
+
+def test_vmt126_clean_on_matching_shorter_or_unknown_rank():
+    # Shorter specs are replication-padded by JAX; unknown-rank arrays
+    # (param x) must not be guessed at.
+    assert not [f for f in _scan(V126_CLEAN, {"VMT126"})
+                if f.rule == "VMT126"]
+
+
+# ----------------------------------------------------------------- VMT127
+V127_POSITIVE = {
+    "pkg/config.py": CONFIG_SRC,
+    "pkg/models/blocks.py": '''
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def encode(x):
+            return jnp.zeros((64, 5), jnp.bfloat16)
+    ''',
+}
+
+V127_CLEAN = {
+    "pkg/config.py": CONFIG_SRC,
+    "pkg/models/blocks.py": '''
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def encode(x, cfg):
+            knob = jnp.zeros((cfg.max_text_len, 5), jnp.bfloat16)
+            vocab = jnp.zeros((37, 101), jnp.bfloat16)
+            small = jnp.zeros((2, 3), jnp.bfloat16)
+            flat = knob.reshape((-1,))
+            return knob, vocab, small, flat
+    ''',
+}
+
+
+def test_vmt127_flags_undeclared_literal_dimension():
+    found = [f for f in _scan(V127_POSITIVE, {"VMT127"})
+             if f.rule == "VMT127"]
+    assert len(found) == 1
+    assert "64" in found[0].message
+
+
+def test_vmt127_clean_on_knob_derived_and_vocabulary_shapes():
+    assert not [f for f in _scan(V127_CLEAN, {"VMT127"})
+                if f.rule == "VMT127"]
+
+
+def test_vmt127_silent_outside_models_engine_paths():
+    sources = {"pkg/config.py": CONFIG_SRC,
+               "pkg/serve/app.py": V127_POSITIVE["pkg/models/blocks.py"]}
+    assert not [f for f in _scan(sources, {"VMT127"})
+                if f.rule == "VMT127"]
+
+
+def test_vmt127_silent_without_knob_vocabulary():
+    # Subset scan without config.py in view: no vocabulary, no guessing.
+    sources = {"pkg/models/blocks.py":
+               V127_POSITIVE["pkg/models/blocks.py"]}
+    assert not [f for f in _scan(sources, {"VMT127"})
+                if f.rule == "VMT127"]

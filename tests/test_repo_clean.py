@@ -90,7 +90,8 @@ def test_whole_program_rules_active_and_scan_covers_tests():
     cfg, _root = load_config(REPO_ROOT)
     ids = {r.id for r in default_rules()}
     assert {"VMT110", "VMT111", "VMT112",
-            "VMT119", "VMT120", "VMT121", "VMT122", "VMT123"} <= ids
+            "VMT119", "VMT120", "VMT121", "VMT122", "VMT123",
+            "VMT124", "VMT125", "VMT126", "VMT127"} <= ids
     assert cfg.layers, "[tool.vmtlint.layers] contracts disappeared"
     assert any(p == "tests" or p.startswith("tests/") for p in cfg.paths)
 

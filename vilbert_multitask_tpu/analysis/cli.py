@@ -9,9 +9,11 @@ Exit codes: 0 clean (new findings only at severities below the gate),
 from __future__ import annotations
 
 import argparse
+import ast
+import json
 import os
 import sys
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Set, Tuple
 
 from vilbert_multitask_tpu.analysis import baseline as bl
 from vilbert_multitask_tpu.analysis import report
@@ -47,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prune-baseline", action="store_true",
                    help="rewrite the baseline dropping stale entries for "
                         "scanned files (keeps justifications) and exit 0")
+    p.add_argument("--check", action="store_true",
+                   help="with --prune-baseline: don't rewrite — fail "
+                        "(exit 1) if the baseline carries stale "
+                        "fingerprints, so fixed findings can't linger "
+                        "as dead suppressions (the CI mode)")
     p.add_argument("--format", default=None, dest="fmt",
                    choices=("human", "json", "sarif"),
                    help="output format (default: human)")
@@ -57,21 +64,77 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_name_status(output: str) -> Tuple[Set[str], Set[str]]:
+    """``git diff --name-status -M`` lines → (paths that exist now and
+    changed, old paths that no longer exist: deletions + rename
+    sources)."""
+    changed: Set[str] = set()
+    removed: Set[str] = set()
+    for line in output.splitlines():
+        parts = line.rstrip("\n").split("\t")
+        if len(parts) < 2 or not parts[0]:
+            continue
+        code = parts[0][0]
+        if code in ("R", "C") and len(parts) >= 3:
+            # R<score>\told\tnew — the new path is scanned; for a rename
+            # the old path is gone and its findings must go with it.
+            changed.add(parts[2])
+            if code == "R":
+                removed.add(parts[1])
+        elif code == "D":
+            removed.add(parts[1])
+        else:  # M, A, T, U ...
+            changed.add(parts[1])
+    return changed, removed
+
+
+def _importers_of(sources: dict, removed_mods: Set[str]) -> Set[str]:
+    """Current files importing any removed module (prefix-overlapping
+    dotted names, over-approximate on purpose: a module that referenced
+    the deleted/renamed file must be rescanned — its cross-module
+    findings may have shifted)."""
+    out: Set[str] = set()
+    for rel, src in sources.items():
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        names: List[str] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names.extend(a.name for a in node.names)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                names.append(node.module)
+                names.extend(f"{node.module}.{a.name}"
+                             for a in node.names)
+        if any(n == m or n.startswith(m + ".") or m.startswith(n + ".")
+               for n in names for m in removed_mods):
+            out.add(rel)
+    return out
+
+
 def _changed_subset(paths: Sequence[str], root: str,
                     exclude: Sequence[str], rev: str
-                    ) -> Optional[List[str]]:
-    """The ``--changed`` scan set (absolute paths), or None for a full
-    scan — when git is unavailable, nothing relevant changed, or the
-    import closure exceeds half the project (at which point the subset
-    machinery costs more than it saves and cross-module blind spots
-    stop being worth it)."""
+                    ) -> Optional[Tuple[List[str], Set[str]]]:
+    """The ``--changed`` scan: (absolute paths to scan, rel paths removed
+    vs REV), or None for a full scan — when git is unavailable, nothing
+    relevant changed, or the import closure exceeds half the project (at
+    which point the subset machinery costs more than it saves and
+    cross-module blind spots stop being worth it).
+
+    Renames and deletions are first-class (``--name-status -M``): the
+    rename target joins the scan set, importers of a removed module are
+    rescanned (the symbols they referenced moved or died), and the
+    removed rel-paths flow back so baseline entries anchored in them go
+    stale instead of lingering forever."""
     import subprocess
 
-    from vilbert_multitask_tpu.analysis.graph import import_closure
+    from vilbert_multitask_tpu.analysis.graph import (import_closure,
+                                                      module_name_for)
 
     try:
         proc = subprocess.run(
-            ["git", "diff", "--name-only", rev, "--"],
+            ["git", "diff", "--name-status", "-M", rev, "--"],
             cwd=root, capture_output=True, text=True, timeout=30)
     except (OSError, subprocess.SubprocessError):
         return None
@@ -80,13 +143,11 @@ def _changed_subset(paths: Sequence[str], root: str,
               f"({proc.stderr.strip().splitlines()[:1]}); full scan",
               file=sys.stderr)
         return None
-    changed = {ln.strip() for ln in proc.stdout.splitlines() if ln.strip()}
+    changed, removed = _parse_name_status(proc.stdout)
     abs_of = {
         os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/"): p
         for p in iter_python_files(paths, exclude=exclude)}
     seeds = changed & set(abs_of)
-    if not seeds:
-        return None
     sources = {}
     for rel, path in abs_of.items():
         try:
@@ -94,15 +155,27 @@ def _changed_subset(paths: Sequence[str], root: str,
                 sources[rel] = f.read()
         except OSError:
             continue
-    closure = import_closure(sources, seeds)
+    removed_mods = {module_name_for(rel) for rel in removed
+                    if rel.endswith(".py")}
+    if removed_mods:
+        seeds |= _importers_of(sources, removed_mods)
+    if not seeds:
+        # Nothing scannable changed. A pure deletion still needs a full
+        # scan so its baseline entries can be judged stale.
+        return None
+    closure = import_closure(sources, seeds & set(sources))
     if len(closure) > len(abs_of) / 2:
         print(f"vmtlint: --changed: closure is {len(closure)}/"
               f"{len(abs_of)} files; full scan", file=sys.stderr)
         return None
-    return [abs_of[rel] for rel in sorted(closure) if rel in abs_of]
+    subset = [abs_of[rel] for rel in sorted(closure) if rel in abs_of]
+    return subset, removed
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "surface":
+        return _surface_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for cls in RULES:
@@ -121,10 +194,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     partial = False
+    removed_rel: Set[str] = set()
     if args.changed is not None:
         subset = _changed_subset(paths, root, cfg.exclude, args.changed)
         if subset is not None:
-            paths, partial = subset, True
+            paths, partial = subset[0], True
+            removed_rel = subset[1]
 
     rules = default_rules(cfg.severity, cfg.rule_paths)
     if partial:
@@ -167,19 +242,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     new, baselined, stale = bl.split_baselined(findings, baseline)
-    # Stale = "the grandfathered finding is gone" — only judgeable for
-    # files this run actually scanned; a subset scan must not condemn
-    # entries for files outside it.
-    stale = [fp for fp in stale
-             if baseline[fp].get("path") in scanned]
+
+    # Stale = "the grandfathered finding is gone" — judgeable for files
+    # this run scanned, files removed vs the --changed rev, and (on a
+    # full scan) files that no longer exist on disk; a subset scan must
+    # not condemn entries for live files outside it.
+    def _entry_stale(fp: str) -> bool:
+        rel = baseline[fp].get("path", "")
+        if rel in scanned or rel in removed_rel:
+            return True
+        return (not partial and bool(rel)
+                and not os.path.exists(os.path.join(root, rel)))
+
+    stale = [fp for fp in stale if _entry_stale(fp)]
 
     if args.prune_baseline:
         if not baseline_path or not os.path.exists(baseline_path):
             print("vmtlint: --prune-baseline needs an existing baseline",
                   file=sys.stderr)
             return 2
-        bl.prune_baseline(baseline_path, stale)
         noun = "entry" if len(stale) == 1 else "entries"
+        if args.check:
+            if stale:
+                for fp in stale:
+                    print(f"vmtlint: stale baseline entry: {fp} "
+                          f"({baseline[fp].get('path', '?')})",
+                          file=sys.stderr)
+                print(f"vmtlint: {len(stale)} stale baseline {noun} — "
+                      f"run --prune-baseline to drop them",
+                      file=sys.stderr)
+                return 1
+            print("vmtlint: baseline clean (no stale entries)",
+                  file=sys.stderr)
+            return 0
+        bl.prune_baseline(baseline_path, stale)
         print(f"vmtlint: pruned {len(stale)} stale baseline {noun} from "
               f"{baseline_path}", file=sys.stderr)
         return 0
@@ -197,6 +293,84 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if stale and args.strict:
             return 1
     return 1 if gate else 0
+
+
+def _surface_main(argv: Sequence[str]) -> int:
+    """``vmtlint surface [--check] [--out FILE] [--format json|sarif]``:
+    build the compile-surface manifest from the library tree (library
+    roots only — the key universe is a property of the shipped package,
+    not its tests) and write, print, or verify it."""
+    from vilbert_multitask_tpu.analysis import surface as surf_mod
+
+    p = argparse.ArgumentParser(
+        prog="python -m vilbert_multitask_tpu.analysis surface",
+        description="Enumerate the engine's XLA compile-key universe "
+                    "(program family × bucket × param_dtype × fused "
+                    "mode × topology × attention mode) with witness "
+                    "chains, as COMPILE_SURFACE.json")
+    p.add_argument("--check", action="store_true",
+                   help="verify the committed manifest matches the tree; "
+                        "exit 1 on drift (the CI gate)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help=f"manifest path (default: <repo>/"
+                        f"{surf_mod.MANIFEST_NAME})")
+    p.add_argument("--format", default="json", dest="fmt",
+                   choices=("json", "sarif"),
+                   help="with no --check: 'json' writes the manifest, "
+                        "'sarif' prints witness codeFlows to stdout")
+    args = p.parse_args(argv)
+
+    cfg, root = load_config(os.getcwd())
+    root = root or os.getcwd()
+    roots = [os.path.join(root, r) for r in cfg.library_roots]
+    roots = [r for r in roots if os.path.exists(r)] or [root]
+    sources = {}
+    for path in iter_python_files(roots, exclude=cfg.exclude):
+        rel = os.path.relpath(os.path.abspath(path),
+                              root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    project = surf_mod.load_project(sources)
+    fresh = surf_mod.build_surface(project)
+    out_path = args.out or os.path.join(root, surf_mod.MANIFEST_NAME)
+
+    if args.check:
+        committed = None
+        if os.path.exists(out_path):
+            try:
+                with open(out_path, "r", encoding="utf-8") as f:
+                    committed = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"vmtlint surface: unreadable manifest "
+                      f"{out_path}: {e}", file=sys.stderr)
+                return 2
+        msgs = surf_mod.diff_surface(committed, fresh)
+        if msgs:
+            for m in msgs:
+                print(f"vmtlint surface: {m}", file=sys.stderr)
+            print("vmtlint surface: compile surface drifted — "
+                  "regenerate with `python -m vilbert_multitask_tpu."
+                  "analysis surface` and commit the result",
+                  file=sys.stderr)
+            return 1
+        print(f"vmtlint surface: check clean — "
+              f"{fresh['record_count']} record(s), "
+              f"{len(fresh['dimensions']['program_families'])} program "
+              f"family(ies)", file=sys.stderr)
+        return 0
+
+    if args.fmt == "sarif":
+        sys.stdout.write(surf_mod.render_surface_sarif(fresh))
+        return 0
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(surf_mod.render_surface(fresh))
+    print(f"vmtlint surface: wrote {fresh['record_count']} record(s) "
+          f"({len(fresh['dimensions']['program_families'])} program "
+          f"family(ies)) to {out_path}", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

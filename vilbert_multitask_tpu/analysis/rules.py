@@ -1993,6 +1993,9 @@ def _instrument_audit(project) -> Dict:
 
 from vilbert_multitask_tpu.analysis.locks import (  # noqa: E402
     JitClosureCapture, LockOrderInversion, WaitHoldingForeignLock)
+from vilbert_multitask_tpu.analysis.shaperules import (  # noqa: E402
+    BucketShapeDrift, DtypePromotionLeak, PartitionRankMismatch,
+    UnboundedCompileKey)
 
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
@@ -2001,7 +2004,9 @@ RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          PerRowTransferInLoop, NakedRetryLoop, UnboundedObsBuffer,
          BlockingCallUnderSchedulerLock, ReplicaAffinityLeak,
          DequantOutsideJit, LockOrderInversion, WaitHoldingForeignLock,
-         JitClosureCapture, ConfigKnobDrift, InstrumentNameDrift]
+         JitClosureCapture, ConfigKnobDrift, InstrumentNameDrift,
+         UnboundedCompileKey, DtypePromotionLeak, PartitionRankMismatch,
+         BucketShapeDrift]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

@@ -1,0 +1,372 @@
+"""Shape-tier rules (VMT124–VMT127), built on the abstract interpreter.
+
+Every recompile-hazard rule before this tier (VMT102 closure capture,
+VMT121 knob drift) reasoned about *names*; these four reason about
+*values*: where a static argument's value originates, which dtype an
+array actually carries after promotion, whether a PartitionSpec's rank
+can fit the array it shards, and whether a literal dimension belongs to
+the declared bucket vocabulary. They live in their own module (like the
+lock rules in locks.py) and are imported into the rules registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.core import Finding, Rule
+from vilbert_multitask_tpu.analysis.shapes import (
+    Array,
+    KnobTable,
+    Scalar,
+    call_nodes_in,
+    flows_from,
+    interpret_function,
+    jit_static_bindings,
+    knob_table,
+)
+
+_PARTITION_SPEC = "jax.sharding.PartitionSpec"
+_SHARDING_SINKS = {"jax.lax.with_sharding_constraint", "jax.device_put"}
+# Dimensions at or below this are structural constants (coords, heads,
+# channels), not bucket-sized axes; only larger literals must trace back
+# to a declared knob.
+_STRUCTURAL_DIM = 8
+
+
+def _project_knobs(ctx: ModuleContext) -> KnobTable:
+    if ctx.project is not None:
+        return knob_table(ctx.project)
+    table = KnobTable()
+    return table
+
+
+def _module_functions(ctx: ModuleContext) -> Iterator[ast.AST]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own scope: no nested defs/lambdas/classes."""
+    todo: List[ast.AST] = list(getattr(fn, "body", ()))
+    while todo:
+        node = todo.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            todo.append(child)
+
+
+def _shape_scalars(val) -> Iterator[Scalar]:
+    """Flatten an abstract shape-ish value to its Scalar dims."""
+    from vilbert_multitask_tpu.analysis.shapes import Tup
+
+    if isinstance(val, Scalar):
+        yield val
+    elif isinstance(val, Tup):
+        for e in val.elts:
+            yield from _shape_scalars(e)
+
+
+class UnboundedCompileKey(Rule):
+    """VMT124: a jitted function's *static* argument receives a value
+    whose provenance is request/data-dependent. Every distinct value is a
+    distinct XLA program — the compile-cache cardinality blowup the
+    bucketing scheme exists to prevent. Values routed through
+    ``bucket_for``/``row_bucket_for``/config knobs are bounded and clean.
+    """
+
+    id = "VMT124"
+    name = "unbounded-compile-key"
+    severity = "error"
+    description = ("static jit argument fed from request/data-dependent "
+                   "values — unbounded compile-cache key universe")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        bindings = jit_static_bindings(ctx)
+        if not bindings:
+            return
+        knobs = _project_knobs(ctx)
+        jit_ids = {id(info.body) for info in ctx.jit_bodies}
+        seen: Set[Tuple[int, str]] = set()
+        for fn in _module_functions(ctx):
+            if id(fn) in jit_ids:
+                # Inside a jit body the static params are already
+                # trace-time constants; JAX itself rejects passing a
+                # traced value onward as static.
+                continue
+            callees = {n.func.id for n in _own_scope(fn)
+                       if isinstance(n, ast.Call)
+                       and isinstance(n.func, ast.Name)
+                       and n.func.id in bindings
+                       and n.func.id != getattr(fn, "name", "")}
+            if not callees:
+                continue
+            interp = interpret_function(ctx, fn, knobs)
+            for event, fact in interp.iter_facts():
+                for call in call_nodes_in(event):
+                    if not (isinstance(call.func, ast.Name)
+                            and call.func.id in callees):
+                        continue
+                    binding = bindings[call.func.id]
+                    for expr, pname in _static_args(call, binding):
+                        key = (id(call), pname)
+                        if key in seen:
+                            continue
+                        val = interp.eval(expr, fact)
+                        if not (isinstance(val, Scalar)
+                                and val.origin in ("param", "data")):
+                            continue
+                        seen.add(key)
+                        f = self.finding(
+                            ctx, call,
+                            f"static argument `{pname}` of jitted "
+                            f"`{binding.name}` is "
+                            f"{_ORIGIN_DESC[val.origin]} — every "
+                            f"distinct value compiles a new XLA "
+                            f"program; route it through "
+                            f"`EngineConfig.bucket_for`/"
+                            f"`row_bucket_for` or a config knob so "
+                            f"the key universe stays bounded")
+                        f.flows = flows_from(
+                            val.witness,
+                            (ctx.rel_path, call.lineno,
+                             f"flows into static arg `{pname}` of "
+                             f"jitted `{binding.name}` — a new value "
+                             f"here is a new XLA program"))
+                        yield f
+
+
+_ORIGIN_DESC = {
+    "param": "caller-controlled (an unconstrained parameter)",
+    "data": "derived from request data (e.g. a payload length)",
+}
+
+
+def _static_args(call: ast.Call, binding
+                 ) -> Iterator[Tuple[ast.expr, str]]:
+    for kw in call.keywords:
+        if kw.arg in binding.static_names:
+            yield kw.value, kw.arg
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            continue
+        if i < len(binding.params) and binding.params[i] in \
+                binding.static_names:
+            yield arg, binding.params[i]
+
+
+class DtypePromotionLeak(Rule):
+    """VMT125: inside jit-traced code, a low-precision operand (bf16/f16/
+    int8) is silently promoted to float32 because the other operand came
+    from a default-dtype constructor (``jnp.zeros(shape)`` with no
+    ``dtype=``). The math runs — at double the HBM traffic the serving
+    path was sized against. Explicit ``dtype=``/`astype` casts are
+    deliberate and never flagged."""
+
+    id = "VMT125"
+    name = "dtype-promotion-leak"
+    severity = "warning"
+    description = ("silent f32 promotion in the bf16/int8 compute path "
+                   "via a default-dtype constructor")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        knobs = _project_knobs(ctx)
+        seen: Set[int] = set()
+        for body, witness in _traced_bodies(ctx):
+            interp = interpret_function(ctx, body, knobs)
+            for node, low, ctor_line in interp.promotions.values():
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                via = f" (traced: {witness})" if witness else ""
+                f = self.finding(
+                    ctx, node,
+                    f"`{low}` operand silently promoted to float32 by "
+                    f"the default-dtype constructor at line "
+                    f"{ctor_line}{via}; pass an explicit `dtype=` to "
+                    f"keep the low-precision path low-precision")
+                f.flows = [[
+                    {"path": ctx.rel_path, "line": ctor_line,
+                     "message": "constructor defaults to float32 — no "
+                                "`dtype=` given"},
+                    {"path": ctx.rel_path, "line": node.lineno,
+                     "message": f"combines with a `{low}` operand: "
+                                f"result widens to float32"},
+                ]]
+                yield f
+
+
+def _traced_bodies(ctx: ModuleContext
+                   ) -> Iterator[Tuple[ast.AST, str]]:
+    """Jit bodies plus project-traced helpers, FunctionDefs only (the CFG
+    builder wants a statement body, which lambdas don't have)."""
+    seen: Set[int] = set()
+    for info in ctx.jit_bodies:
+        body = info.body
+        if isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and id(body) not in seen:
+            seen.add(id(body))
+            yield body, ""
+    if ctx.project is not None:
+        for info, witness in ctx.project.traced_helpers(ctx):
+            body = info.body
+            if isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(body) not in seen:
+                seen.add(id(body))
+                yield body, witness
+
+
+class PartitionRankMismatch(Rule):
+    """VMT126: a ``PartitionSpec`` names more axes than the array it
+    constrains has dimensions. VMT111 checks the axis *names* against the
+    project's declared mesh; this checks the *rank* against the abstract
+    shape — the mismatch XLA reports only at trace time on a real mesh.
+    Specs shorter than the rank are fine (JAX pads with replication)."""
+
+    id = "VMT126"
+    name = "partition-rank-mismatch"
+    severity = "error"
+    description = "PartitionSpec rank exceeds the abstract array rank"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if "PartitionSpec" not in ctx.source:
+            return
+        knobs = _project_knobs(ctx)
+        seen: Set[int] = set()
+        for fn in _module_functions(ctx):
+            sinks = [n for n in _own_scope(fn)
+                     if isinstance(n, ast.Call)
+                     and ctx.resolve(n.func) in _SHARDING_SINKS
+                     and len(n.args) >= 2]
+            if not sinks:
+                continue
+            interp = interpret_function(ctx, fn, knobs)
+            for event, fact in interp.iter_facts():
+                for call in call_nodes_in(event):
+                    if not (isinstance(call, ast.Call)
+                            and ctx.resolve(call.func) in _SHARDING_SINKS
+                            and len(call.args) >= 2):
+                        continue
+                    if id(call) in seen:
+                        continue
+                    val = interp.eval(call.args[0], fact)
+                    rank = _rank_of(val)
+                    if rank is None:
+                        continue
+                    for spec in _partition_specs(ctx, call.args[1]):
+                        spec_rank = _spec_rank(spec)
+                        if spec_rank is None or spec_rank <= rank:
+                            continue
+                        seen.add(id(call))
+                        yield self.finding(
+                            ctx, spec,
+                            f"PartitionSpec names {spec_rank} axes but "
+                            f"the constrained array has rank {rank} — "
+                            f"XLA rejects this at trace time on a real "
+                            f"mesh; drop the extra axes or reshape "
+                            f"first")
+
+
+def _rank_of(val) -> Optional[int]:
+    from vilbert_multitask_tpu.analysis.shapes import Tree, is_int8_pair
+
+    if isinstance(val, Array):
+        return val.rank
+    if isinstance(val, Tree) and is_int8_pair(val):
+        inner = val.child("int8")
+        if isinstance(inner, Array):
+            return inner.rank
+    return None
+
+
+def _partition_specs(ctx: ModuleContext, expr: ast.expr
+                     ) -> Iterator[ast.Call]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) \
+                and ctx.resolve(node.func) == _PARTITION_SPEC:
+            yield node
+
+
+def _spec_rank(spec: ast.Call) -> Optional[int]:
+    if any(isinstance(a, ast.Starred) for a in spec.args):
+        return None
+    return len(spec.args)
+
+
+class BucketShapeDrift(Rule):
+    """VMT127: a literal dimension in jit-traced models/engine code that
+    the declared config-knob vocabulary (bucket tuples, max_text_len,
+    max_regions, …) cannot produce. A shape the bucketing scheme doesn't
+    know about means a compile the warmup never warms and the AOT
+    manifest never lists — a silent recompile on the serving path."""
+
+    id = "VMT127"
+    name = "bucket-shape-drift"
+    severity = "warning"
+    description = ("literal shape in models/engine jit code not "
+                   "derivable from declared buckets/knobs")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        parts = ctx.rel_path.split("/")
+        if "models" not in parts and "engine" not in parts:
+            return
+        knobs = _project_knobs(ctx)
+        if knobs.empty:
+            # Subset scan without config.py in view: no vocabulary to
+            # judge against, so stay silent rather than guess.
+            return
+        vocab = knobs.ints()
+        seen: Set[Tuple[int, int]] = set()
+        for body, _witness in _traced_bodies(ctx):
+            interp = interpret_function(ctx, body, knobs)
+            for event, fact in interp.iter_facts():
+                for call in call_nodes_in(event):
+                    for expr in _shape_exprs(ctx, call):
+                        val = interp.eval(expr, fact)
+                        for dim in _shape_scalars(val):
+                            if not (dim.origin == "literal"
+                                    and isinstance(dim.value, int)
+                                    and not isinstance(dim.value, bool)):
+                                continue
+                            if dim.value <= _STRUCTURAL_DIM \
+                                    or dim.value in vocab:
+                                continue
+                            key = (id(call), dim.value)
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            yield self.finding(
+                                ctx, call,
+                                f"literal dimension {dim.value} is not "
+                                f"derivable from any declared config "
+                                f"knob or bucket — this shape compiles "
+                                f"outside the declared universe (never "
+                                f"warmed, never AOT-cached); derive it "
+                                f"from a config knob or add it to the "
+                                f"bucket vocabulary")
+
+
+def _shape_exprs(ctx: ModuleContext, call: ast.Call
+                 ) -> Iterator[ast.expr]:
+    """The shape-position argument expressions of a constructor/reshape/
+    pad/broadcast call (the places literal dims sneak in)."""
+    func = call.func
+    resolved = ctx.resolve(func)
+    leaf = resolved.split(".")[-1] if resolved else ""
+    ns = resolved.startswith(("jax.numpy.", "numpy."))
+    if ns and leaf in ("zeros", "ones", "full", "empty") and call.args:
+        yield call.args[0]
+    elif ns and leaf == "broadcast_to" and len(call.args) >= 2:
+        yield call.args[1]
+    elif ns and leaf == "pad" and len(call.args) >= 2:
+        yield call.args[1]
+    elif isinstance(func, ast.Attribute) and func.attr == "reshape":
+        yield from call.args
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            yield kw.value

@@ -39,6 +39,14 @@ echo "== compile surface (COMPILE_SURFACE.json vs the tree) =="
 # `python -m vilbert_multitask_tpu.analysis surface` and commit.
 python -m vilbert_multitask_tpu.analysis surface --check || fail=1
 
+echo "== durable-state surface (TXN_SURFACE.json vs the tree) =="
+# The committed manifest enumerates the sqlite durable state (tables +
+# migrated schema, every transaction site with its mode, the recovered
+# status state machines). Drift means someone changed a store without
+# regenerating the contract ROADMAP item 3's multi-process work reads —
+# rerun `python -m vilbert_multitask_tpu.analysis txn` and commit.
+python -m vilbert_multitask_tpu.analysis txn --check || fail=1
+
 if [[ "${1:-}" == "--lint" ]]; then
   exit "$fail"
 fi

@@ -1,0 +1,467 @@
+"""Transaction tier suite: the SQL statement model, connection-scope
+recovery, VMT128-131 hazard/clean pairs, and the durable-state manifest
+(TXN_SURFACE.json) — discovery, determinism, drift detection, and the
+byte-for-byte committed-manifest gate CI runs via ``txn --check``.
+
+Rule fixtures are multi-module dicts through ``analyze_project`` (the
+scopes resolve their connection factory through the ProjectGraph, so a
+single-module scan would miss the cross-file shape the real stores use).
+"""
+
+import ast
+import copy
+import json
+import os
+import textwrap
+
+import pytest
+
+from vilbert_multitask_tpu.analysis import analyze_project
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.graph import ProjectGraph
+from vilbert_multitask_tpu.analysis import txn as txn_mod
+from vilbert_multitask_tpu.analysis.sql import statements_from_call
+from vilbert_multitask_tpu.analysis.txn import (
+    build_txn_surface,
+    diff_txn_surface,
+    render_txn_surface,
+    render_txn_surface_sarif,
+    txn_flow,
+)
+from vilbert_multitask_tpu.analysis.txnrules import SqlSchemaDrift
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, txn_mod.MANIFEST_NAME)
+
+
+def project(sources):
+    ctxs = []
+    for path in sorted(sources):
+        src = textwrap.dedent(sources[path])
+        ctxs.append(ModuleContext(path, src, ast.parse(src)))
+    graph = ProjectGraph(ctxs)
+    for c in ctxs:
+        c.project = graph
+    return graph
+
+
+def findings(sources):
+    return analyze_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        library_roots=("pkg", "vilbert_multitask_tpu"))
+
+
+def rules_hit(sources):
+    return {f.rule for f in findings(sources)}
+
+
+def _library_sources():
+    out = {}
+    lib = os.path.join(REPO, "vilbert_multitask_tpu")
+    for dirpath, dirnames, filenames in os.walk(lib):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, REPO).replace(os.sep, "/")
+            with open(full, "r", encoding="utf-8") as f:
+                out[rel] = f.read()
+    return out
+
+
+@pytest.fixture(scope="module")
+def repo_flow():
+    srcs = {p: s for p, s in _library_sources().items()}
+    graph = project(srcs)
+    return txn_flow(graph)
+
+
+@pytest.fixture(scope="module")
+def fresh_surface():
+    graph = project(_library_sources())
+    return build_txn_surface(graph)
+
+
+# The seeded hazard: the pre-fix nack() shape — SELECT feeding a
+# dependent write on the same table under the deferred default.
+_DEFERRED_RMW = {
+    "pkg/store.py": """
+    import sqlite3
+
+    class Store:
+        def _conn(self):
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            return conn
+
+        def nack(self, job_id):
+            with self._conn() as c:
+                row = c.execute(
+                    "SELECT attempts FROM jobs WHERE id=?", (job_id,)
+                ).fetchone()
+                if row is None:
+                    return "gone"
+                status = "dead" if row[0] >= 3 else "pending"
+                c.execute(
+                    "UPDATE jobs SET status=? WHERE id=?",
+                    (status, job_id),
+                )
+                return status
+    """,
+}
+
+
+# ------------------------------------------------------------- SQL model
+def _statements(src, method="execute"):
+    """All SqlStatements of the first ``.{method}(`` call in ``src``."""
+    graph = project({"pkg/m.py": src})
+    ctx = graph.modules["pkg.m"].ctx
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == method):
+            return statements_from_call(ctx, node)
+    raise AssertionError("no execute call in fixture")
+
+
+def test_sql_model_classifies_select_with_guards():
+    sts = _statements("""
+    def read(c, qn):
+        return c.execute(
+            "SELECT id, body FROM jobs WHERE queue=? AND status='pending' "
+            "ORDER BY id LIMIT 1", (qn,)).fetchone()
+    """)
+    (st,) = sts
+    assert st.kind == "select" and st.tables == ("jobs",)
+    assert st.where_literals.get("status") == "pending"
+    assert "id" in st.order_by and st.has_limit
+    assert not st.spliced
+
+
+def test_sql_model_expands_fstring_not_in_splice():
+    # The claim() shape: a runtime-length placeholder list spliced into
+    # the WHERE — the statement must still parse, marked spliced.
+    sts = _statements("""
+    def claim(c, qn, exclude):
+        not_in = (
+            " AND id NOT IN ({})".format(",".join("?" * len(exclude)))
+            if exclude else ""
+        )
+        return c.execute(
+            "SELECT id FROM jobs "
+            f"WHERE queue=? AND status='pending'{not_in} "
+            "ORDER BY id LIMIT 1", (qn, *exclude)).fetchone()
+    """)
+    assert all(st.kind == "select" and st.has_limit for st in sts)
+    assert any(st.spliced for st in sts)
+    assert all(st.where_literals.get("status") == "pending" for st in sts)
+
+
+def test_sql_model_expands_covarying_migration_loop():
+    sts = _statements("""
+    def migrate(c):
+        for col, decl in (("a", "INTEGER"), ("b", "TEXT"),
+                          ("edited", "INTEGER DEFAULT 0")):
+            c.execute(f"ALTER TABLE tasks ADD COLUMN {col} {decl}")
+    """)
+    assert [st.kind for st in sts] == ["alter_table"] * 3
+    assert {st.schema_columns[0][0] for st in sts} == {"a", "b", "edited"}
+
+
+def test_sql_model_splits_executescript():
+    sts = _statements("""
+    def boot(c):
+        c.executescript(\"\"\"
+            CREATE TABLE IF NOT EXISTS a (x INTEGER PRIMARY KEY);
+            CREATE TABLE IF NOT EXISTS b (y TEXT);
+            CREATE INDEX IF NOT EXISTS b_y ON b (y);
+        \"\"\")
+    """, method="executescript")
+    assert [st.kind for st in sts] == ["create_table", "create_table",
+                                      "create_index"]
+    assert [st.tables[0] for st in sts] == ["a", "b", "b"]
+
+
+def test_sql_model_maps_set_params_to_placeholder_index():
+    (st,) = _statements("""
+    def touch(c, s, t, i):
+        c.execute("UPDATE jobs SET status=?, claimed_at=? WHERE id=?",
+                  (s, t, i))
+    """)
+    assert st.set_params == {"status": 0, "claimed_at": 1}
+    assert "id" in st.where_columns
+
+
+# ------------------------------------------------------- scope recovery
+def test_scopes_resolve_factory_through_project_graph():
+    graph = project(_DEFERRED_RMW)
+    flow = txn_flow(graph)
+    assert flow.factories == {"_conn"}
+    (scope,) = flow.scopes
+    assert scope.kind == "with" and scope.mode == "deferred"
+    assert len(scope.sites) == 2
+
+
+def test_direct_sqlite_connect_with_is_a_scope():
+    graph = project({"pkg/m.py": """
+    import sqlite3
+
+    def count(path):
+        with sqlite3.connect(path) as c:
+            return c.execute("SELECT COUNT(*) FROM jobs").fetchone()
+    """})
+    (scope,) = txn_flow(graph).scopes
+    assert scope.factory == "sqlite3.connect" and scope.mode == "deferred"
+
+
+def test_explicit_begin_immediate_flips_scope_mode():
+    srcs = copy.deepcopy(_DEFERRED_RMW)
+    srcs["pkg/store.py"] = srcs["pkg/store.py"].replace(
+        'row = c.execute(',
+        'c.execute("BEGIN IMMEDIATE")\n'
+        '                row = c.execute(')
+    (scope,) = txn_flow(project(srcs)).scopes
+    assert scope.mode == "immediate"
+
+
+# ---------------------------------------------------------------- VMT128
+def test_vmt128_fires_on_deferred_rmw_with_witness_chain():
+    fs = [f for f in findings(_DEFERRED_RMW) if f.rule == "VMT128"]
+    (f,) = fs
+    assert f.severity == "error"
+    assert "jobs" in f.message and "BEGIN IMMEDIATE" in f.message
+    (chain,) = f.flows
+    assert len(chain) >= 2
+    assert "SELECT" in chain[0]["message"]
+    assert "UPDATE" in chain[-1]["message"]
+
+
+def test_vmt128_quiet_on_begin_immediate_twin():
+    srcs = copy.deepcopy(_DEFERRED_RMW)
+    srcs["pkg/store.py"] = srcs["pkg/store.py"].replace(
+        'row = c.execute(',
+        'c.execute("BEGIN IMMEDIATE")\n'
+        '                row = c.execute(')
+    assert "VMT128" not in rules_hit(srcs)
+
+
+def test_vmt128_quiet_on_independent_write():
+    # Same scope, same table, but the write neither consumes the read's
+    # result nor sits behind a guard on it — no RMW dependency.
+    assert "VMT128" not in rules_hit({"pkg/m.py": """
+    import sqlite3
+
+    def tick(path, now):
+        with sqlite3.connect(path) as c:
+            rows = c.execute("SELECT id FROM jobs").fetchall()
+            c.execute("UPDATE jobs SET claimed_at=?", (now,))
+            return rows
+    """})
+
+
+# ---------------------------------------------------------------- VMT129
+_MIGRATION = {
+    "pkg/db.py": """
+    import sqlite3
+
+    def boot(path):
+        with sqlite3.connect(path) as c:
+            c.execute("CREATE TABLE IF NOT EXISTS tasks "
+                      "(id INTEGER PRIMARY KEY, name TEXT)")
+            cols = {r[1] for r in c.execute("PRAGMA table_info(tasks)")}
+            if "edited" not in cols:
+                c.execute("ALTER TABLE tasks ADD COLUMN "
+                          "edited INTEGER DEFAULT 0")
+            c.execute("INSERT INTO tasks (id, name) VALUES (?, ?)",
+                      (1, "seed"))
+    """,
+}
+
+
+def test_vmt129_fires_on_split_migration():
+    fs = [f for f in findings(_MIGRATION) if f.rule == "VMT129"]
+    (f,) = fs
+    assert f.severity == "error" and "tasks" in f.message
+
+
+def test_vmt129_quiet_under_explicit_txn_and_across_tables():
+    srcs = copy.deepcopy(_MIGRATION)
+    srcs["pkg/db.py"] = srcs["pkg/db.py"].replace(
+        'c.execute("CREATE TABLE',
+        'c.execute("BEGIN IMMEDIATE")\n'
+        '            c.execute("CREATE TABLE')
+    assert "VMT129" not in rules_hit(srcs)
+    # Unrelated tables in one scope are independent autocommits: fine.
+    assert "VMT129" not in rules_hit({"pkg/m.py": """
+    import sqlite3
+
+    def boot(path):
+        with sqlite3.connect(path) as c:
+            c.execute("CREATE TABLE IF NOT EXISTS a (x INTEGER)")
+            c.execute("CREATE TABLE IF NOT EXISTS b (y INTEGER)")
+    """})
+
+
+# ---------------------------------------------------------------- VMT130
+_SCHEMA_PROJ = {
+    "pkg/db.py": """
+    import sqlite3
+
+    def boot(path):
+        with sqlite3.connect(path) as c:
+            c.execute("BEGIN IMMEDIATE")
+            c.execute("CREATE TABLE IF NOT EXISTS jobs "
+                      "(id INTEGER PRIMARY KEY, status TEXT, "
+                      "attempts INTEGER)")
+            c.execute("ALTER TABLE jobs ADD COLUMN claimed_by TEXT")
+
+    def read(path):
+        with sqlite3.connect(path) as c:
+            return c.execute(
+                "SELECT id, status, attempts, claimed_by FROM jobs"
+            ).fetchall()
+    """,
+}
+
+
+def test_vmt130_models_migrated_columns():
+    # claimed_by only exists via the ALTER migration; querying it is
+    # clean, and nothing else drifts.
+    assert "VMT130" not in rules_hit(_SCHEMA_PROJ)
+
+
+def test_vmt130_unknown_column_with_did_you_mean():
+    srcs = copy.deepcopy(_SCHEMA_PROJ)
+    srcs["pkg/db.py"] = srcs["pkg/db.py"].replace(
+        "SELECT id, status, attempts, claimed_by",
+        "SELECT id, statuz, attempts, claimed_by")
+    fs = [f for f in findings(srcs) if f.rule == "VMT130"]
+    (unknown,) = [f for f in fs if "statuz" in f.message]
+    assert "status" in unknown.message  # did-you-mean
+    # ...and the orphaned declaration now reads nowhere: dead direction.
+    assert any("never read" in f.message for f in fs)
+
+
+def test_vmt130_dead_column_needs_whole_project_scan():
+    srcs = copy.deepcopy(_SCHEMA_PROJ)
+    srcs["pkg/db.py"] = srcs["pkg/db.py"].replace(
+        "SELECT id, status, attempts, claimed_by", "SELECT id, attempts")
+    dead = [f for f in findings(srcs) if f.rule == "VMT130"]
+    assert len(dead) == 2  # status and claimed_by now unread
+    assert all("never read" in f.message for f in dead)
+    # --changed subset scans can't prove project-wide absence: the
+    # partial_scan degradation VMT122 pioneered applies here too.
+    rule = SqlSchemaDrift()
+    rule.partial_scan = True
+    graph = project(srcs)
+    ctx = graph.modules["pkg.db"].ctx
+    assert list(rule.check(ctx)) == []
+
+
+# ---------------------------------------------------------------- VMT131
+def test_vmt131_fires_on_unordered_claim_and_quiet_with_order_by():
+    claim = {"pkg/q.py": """
+    import sqlite3
+
+    def claim(path, now):
+        with sqlite3.connect(path) as c:
+            c.execute("BEGIN IMMEDIATE")
+            row = c.execute(
+                "SELECT id FROM jobs WHERE status='pending' LIMIT 1"
+            ).fetchone()
+            if row is None:
+                return None
+            c.execute("UPDATE jobs SET status='inflight', claimed_at=? "
+                      "WHERE id=?", (now, row[0]))
+            return row[0]
+    """}
+    assert "VMT131" in rules_hit(claim)
+    ordered = {"pkg/q.py": claim["pkg/q.py"].replace(
+        "LIMIT 1", "ORDER BY id LIMIT 1")}
+    assert "VMT131" not in rules_hit(ordered)
+
+
+# ------------------------------------------------------ the real stores
+def test_repo_stores_carry_no_txn_hazards(repo_flow):
+    # The seeded bugs are fixed in-tree: every RMW scope takes the write
+    # lock and the boot migrations are single transactions.
+    assert repo_flow.rmw == []
+    assert repo_flow.multi_write == []
+    assert repo_flow.claims == []
+    # The one accepted drift is baselined (fleet_instruments.updated_unix).
+    assert [(d["kind"], d["path"]) for d in repo_flow.drift] == [
+        ("dead", "vilbert_multitask_tpu/obs/fleet.py")]
+
+
+def test_repo_rmw_scopes_are_immediate(repo_flow):
+    modes = {s.function.split(":", 1)[1]: s.mode for s in repo_flow.scopes}
+    for fn in ("DurableQueue.nack", "DurableQueue.claim",
+               "DurableQueue.pop_dead_letters", "DurableQueue.__init__",
+               "ResultStore.__init__", "ResultStore.create_question"):
+        assert modes[fn] == "immediate", (fn, modes[fn])
+
+
+# ---------------------------------------------------------------- manifest
+def test_surface_models_migrated_jobs_schema(fresh_surface):
+    jobs = fresh_surface["tables"]["jobs"]
+    by_name = {c["name"]: c for c in jobs["columns"]}
+    assert {"id", "queue", "body", "status", "attempts", "claimed_at",
+            "created_at", "delivery_count", "dead_notified",
+            "claimed_by"} == set(by_name)
+    assert by_name["status"]["origin"] == "create"
+    for col in ("delivery_count", "dead_notified", "claimed_by"):
+        assert by_name[col]["origin"] == "alter"
+
+
+def test_surface_recovers_status_state_machine(fresh_surface):
+    status = fresh_surface["state_machines"]["jobs"]["status"]
+    assert status["initial"] == "pending"
+    assert status["values"] == ["dead", "inflight", "pending"]
+    edges = {(t.get("from"), t["to"]) for t in status["transitions"]}
+    assert {("pending", "inflight"), ("inflight", "pending"),
+            ("pending", "dead")} <= edges
+    notified = fresh_surface["state_machines"]["jobs"]["dead_notified"]
+    assert ("0", "1") in {(t.get("from"), t["to"])
+                          for t in notified["transitions"]}
+
+
+def test_surface_is_deterministic():
+    a = render_txn_surface(build_txn_surface(project(_library_sources())))
+    b = render_txn_surface(build_txn_surface(project(_library_sources())))
+    assert a == b
+
+
+def test_committed_manifest_matches_tree_byte_for_byte(fresh_surface):
+    with open(MANIFEST, "r", encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == render_txn_surface(fresh_surface), (
+        "TXN_SURFACE.json drifted — regenerate with `python -m "
+        "vilbert_multitask_tpu.analysis txn` and commit")
+
+
+def test_diff_reports_schema_and_site_drift(fresh_surface):
+    assert diff_txn_surface(None, fresh_surface)  # missing manifest
+    mutated = copy.deepcopy(fresh_surface)
+    mutated["tables"]["jobs"]["columns"].pop()
+    msgs = diff_txn_surface(mutated, fresh_surface)
+    assert any("jobs" in m for m in msgs)
+    mutated = copy.deepcopy(fresh_surface)
+    mutated["txn_sites"][0]["mode"] = "autocommit"
+    assert any("transaction sites" in m
+               for m in diff_txn_surface(mutated, fresh_surface))
+
+
+def test_sarif_rendering_carries_site_flows(fresh_surface):
+    doc = json.loads(render_txn_surface_sarif(fresh_surface))
+    results = doc["runs"][0]["results"]
+    assert len(results) >= fresh_surface["counts"]["txn_sites"]
+    assert any(r["ruleId"] == "TXN-STATE-MACHINE" for r in results)
+    for r in results:
+        assert r["codeFlows"][0]["threadFlows"][0]["locations"]
+
+
+def test_txn_check_gate_is_clean(monkeypatch):
+    from vilbert_multitask_tpu.analysis.cli import main as cli_main
+
+    monkeypatch.chdir(REPO)
+    assert cli_main(["txn", "--check"]) == 0

@@ -91,7 +91,8 @@ def test_whole_program_rules_active_and_scan_covers_tests():
     ids = {r.id for r in default_rules()}
     assert {"VMT110", "VMT111", "VMT112",
             "VMT119", "VMT120", "VMT121", "VMT122", "VMT123",
-            "VMT124", "VMT125", "VMT126", "VMT127"} <= ids
+            "VMT124", "VMT125", "VMT126", "VMT127",
+            "VMT128", "VMT129", "VMT130", "VMT131"} <= ids
     assert cfg.layers, "[tool.vmtlint.layers] contracts disappeared"
     assert any(p == "tests" or p.startswith("tests/") for p in cfg.paths)
 

@@ -176,6 +176,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "surface":
         return _surface_main(argv[1:])
+    if argv and argv[0] == "txn":
+        return _txn_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for cls in RULES:
@@ -370,6 +372,85 @@ def _surface_main(argv: Sequence[str]) -> int:
     print(f"vmtlint surface: wrote {fresh['record_count']} record(s) "
           f"({len(fresh['dimensions']['program_families'])} program "
           f"family(ies)) to {out_path}", file=sys.stderr)
+    return 0
+
+
+def _txn_main(argv: Sequence[str]) -> int:
+    """``vmtlint txn [--check] [--out FILE] [--format json|sarif]``:
+    build the durable-state manifest (tables, transaction sites, state
+    machines) from the library tree and write, print, or verify it —
+    the TXN_SURFACE.json twin of ``surface``."""
+    from vilbert_multitask_tpu.analysis import surface as surf_mod
+    from vilbert_multitask_tpu.analysis import txn as txn_mod
+
+    p = argparse.ArgumentParser(
+        prog="python -m vilbert_multitask_tpu.analysis txn",
+        description="Enumerate the durable-state surface of the sqlite "
+                    "stores (tables + migrated schema, transaction "
+                    "sites with modes, literal-write state machines), "
+                    "as TXN_SURFACE.json")
+    p.add_argument("--check", action="store_true",
+                   help="verify the committed manifest matches the tree; "
+                        "exit 1 on drift (the CI gate)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help=f"manifest path (default: <repo>/"
+                        f"{txn_mod.MANIFEST_NAME})")
+    p.add_argument("--format", default="json", dest="fmt",
+                   choices=("json", "sarif"),
+                   help="with no --check: 'json' writes the manifest, "
+                        "'sarif' prints txn-site witnesses to stdout")
+    args = p.parse_args(argv)
+
+    cfg, root = load_config(os.getcwd())
+    root = root or os.getcwd()
+    roots = [os.path.join(root, r) for r in cfg.library_roots]
+    roots = [r for r in roots if os.path.exists(r)] or [root]
+    sources = {}
+    for path in iter_python_files(roots, exclude=cfg.exclude):
+        rel = os.path.relpath(os.path.abspath(path),
+                              root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    project = surf_mod.load_project(sources)
+    fresh = txn_mod.build_txn_surface(project)
+    out_path = args.out or os.path.join(root, txn_mod.MANIFEST_NAME)
+
+    if args.check:
+        committed = None
+        if os.path.exists(out_path):
+            try:
+                with open(out_path, "r", encoding="utf-8") as f:
+                    committed = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"vmtlint txn: unreadable manifest "
+                      f"{out_path}: {e}", file=sys.stderr)
+                return 2
+        msgs = txn_mod.diff_txn_surface(committed, fresh)
+        if msgs:
+            for m in msgs:
+                print(f"vmtlint txn: {m}", file=sys.stderr)
+            print("vmtlint txn: durable-state surface drifted — "
+                  "regenerate with `python -m vilbert_multitask_tpu."
+                  "analysis txn` and commit the result",
+                  file=sys.stderr)
+            return 1
+        print(f"vmtlint txn: check clean — "
+              f"{fresh['counts']['tables']} table(s), "
+              f"{fresh['counts']['txn_sites']} transaction site(s)",
+              file=sys.stderr)
+        return 0
+
+    if args.fmt == "sarif":
+        sys.stdout.write(txn_mod.render_txn_surface_sarif(fresh))
+        return 0
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(txn_mod.render_txn_surface(fresh))
+    print(f"vmtlint txn: wrote {fresh['counts']['tables']} table(s), "
+          f"{fresh['counts']['txn_sites']} transaction site(s) to "
+          f"{out_path}", file=sys.stderr)
     return 0
 
 

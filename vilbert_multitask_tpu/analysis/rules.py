@@ -1996,6 +1996,8 @@ from vilbert_multitask_tpu.analysis.locks import (  # noqa: E402
 from vilbert_multitask_tpu.analysis.shaperules import (  # noqa: E402
     BucketShapeDrift, DtypePromotionLeak, PartitionRankMismatch,
     UnboundedCompileKey)
+from vilbert_multitask_tpu.analysis.txnrules import (  # noqa: E402
+    MultiWriteNoTxn, NondeterministicClaim, RmwDeferredTxn, SqlSchemaDrift)
 
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
@@ -2006,7 +2008,8 @@ RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          DequantOutsideJit, LockOrderInversion, WaitHoldingForeignLock,
          JitClosureCapture, ConfigKnobDrift, InstrumentNameDrift,
          UnboundedCompileKey, DtypePromotionLeak, PartitionRankMismatch,
-         BucketShapeDrift]
+         BucketShapeDrift, RmwDeferredTxn, MultiWriteNoTxn, SqlSchemaDrift,
+         NondeterministicClaim]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

@@ -1,0 +1,617 @@
+"""In-tree SQL-statement model for the transaction tier.
+
+The data plane speaks sqlite through string literals in three stores
+(``serve/queue.py``, ``serve/db.py``, ``obs/fleet.py``), so the atomicity
+contract ROADMAP item 3 needs — "every read-modify-write is one
+transaction" — is statically visible in the AST. This module recovers the
+statement-level facts: extract SQL strings from ``execute``-family call
+sites (including f-string splices like ``claim()``'s ``NOT IN``
+placeholder list and ``executescript`` of a module-level schema
+constant), classify each statement, and parse the tables, columns
+read/written, WHERE guards, ``ORDER BY`` presence, and ``CREATE TABLE`` /
+``ALTER TABLE`` schema deltas that the rules and the ``TXN_SURFACE.json``
+manifest consume.
+
+This is not a SQL parser — it is a model of the dialect this repo
+actually writes (and the fixtures test): single-table DML, upserts,
+partial indexes, and ``BEGIN IMMEDIATE``. Unresolvable splices degrade to
+an empty segment with ``spliced=True`` so downstream checks can stay
+conservative instead of guessing.
+
+Stdlib-only, like the rest of the analysis package (layer contract:
+no jax / numpy / serve imports — the stores are analyzed as source).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+
+EXECUTE_METHODS = ("execute", "executemany", "executescript")
+
+_KEYWORDS = frozenset("""
+select insert update delete create alter drop table index unique if not
+exists from where and or order group by limit offset on conflict do set
+values into as is null like in between primary key autoincrement integer
+text real blob default asc desc distinct count min max sum avg coalesce
+begin immediate exclusive deferred transaction commit rollback pragma
+replace ignore abort fail when then case else end cast exists having
+""".split())
+
+_IDENT = r"[A-Za-z_][A-Za-z0-9_]*"
+_IDENT_RE = re.compile(_IDENT)
+_DOTTED_RE = re.compile(rf"({_IDENT})\.({_IDENT})")
+_FUNC_RE = re.compile(rf"({_IDENT})\s*\(")
+_WS_RE = re.compile(r"\s+")
+
+# A column compared against something — the guard positions the state
+# machine and the schema-drift check read.
+_GUARD_RE = re.compile(
+    rf"((?:{_IDENT}\.)?{_IDENT})\s*(=|!=|<>|<=|>=|<|>|\bIS\b|\bIN\b|"
+    rf"\bNOT\s+IN\b|\bLIKE\b)\s*('[^']*'|\d+(?:\.\d+)?|\?)?",
+    re.IGNORECASE)
+
+
+class SqlStatement:
+    """One parsed statement plus its AST anchor.
+
+    ``columns_read`` / ``columns_written`` are candidate column tokens in
+    structurally-confident positions only; table names, SQL keywords, and
+    function names never appear in them. ``where_literals`` maps guard
+    columns to the literal they are compared equal to (``'pending'`` →
+    ``pending``, ``0`` → ``0``); ``set_params`` maps a ``SET col=?``
+    column to its positional ``?`` index in the whole statement, so the
+    transaction tier can resolve the python-side literal that flows in.
+    """
+
+    __slots__ = ("raw", "kind", "tables", "columns_read", "columns_written",
+                 "where_columns", "where_literals", "order_by", "group_by",
+                 "has_limit", "set_columns", "set_params", "set_literals",
+                 "schema_columns", "spliced", "node", "begin_mode")
+
+    def __init__(self, raw: str, node: Optional[ast.AST] = None,
+                 spliced: bool = False):
+        self.raw = raw
+        self.node = node
+        self.spliced = spliced
+        self.kind = "other"
+        self.tables: Tuple[str, ...] = ()
+        self.columns_read: Tuple[str, ...] = ()
+        self.columns_written: Tuple[str, ...] = ()
+        self.where_columns: Tuple[str, ...] = ()
+        self.where_literals: Dict[str, str] = {}
+        self.order_by: Tuple[str, ...] = ()
+        self.group_by: Tuple[str, ...] = ()
+        self.has_limit = False
+        self.set_columns: Tuple[str, ...] = ()
+        self.set_params: Dict[str, int] = {}
+        self.set_literals: Dict[str, str] = {}
+        # CREATE TABLE: [(col, decl)]; ALTER ADD COLUMN: the one added col.
+        self.schema_columns: Tuple[Tuple[str, str], ...] = ()
+        self.begin_mode: Optional[str] = None  # for kind == "begin"
+        _parse_into(self)
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind in ("insert", "update", "delete")
+
+    @property
+    def is_schema_write(self) -> bool:
+        return self.kind in ("create_table", "alter_table")
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"SqlStatement({self.kind} {self.tables} {self.raw[:40]!r})"
+
+
+# --------------------------------------------------------------- parsing
+def _normalize(text: str) -> str:
+    return _WS_RE.sub(" ", text).strip().rstrip(";").strip()
+
+_SQL_STR_RE = re.compile(r"'[^']*'")
+
+
+def _idents(text: str) -> List[str]:
+    """Bare + dotted column candidates in ``text``: quoted SQL literals,
+    function names, and keywords are dropped; ``tbl.col`` yields ``col``
+    (``excluded.col`` names the incoming upsert row, not a stored read,
+    and is skipped)."""
+    text = _SQL_STR_RE.sub("''", text)
+    out: List[str] = []
+    funcs = {m.group(1).lower() for m in _FUNC_RE.finditer(text)}
+    skip_quals = {"excluded"}
+    spans = []
+    for m in _DOTTED_RE.finditer(text):
+        spans.append(m.span())
+        if m.group(1).lower() not in skip_quals:
+            out.append(m.group(2))
+    for m in _IDENT_RE.finditer(text):
+        if any(a <= m.start() < b for a, b in spans):
+            continue
+        tok = m.group(0)
+        low = tok.lower()
+        if low in _KEYWORDS or low in funcs or low in skip_quals:
+            continue
+        out.append(tok)
+    return out
+
+
+def _clause(text_u: str, text: str, start_kw: str,
+            end_kws: Sequence[str]) -> Optional[str]:
+    """The region after ``start_kw`` up to the first of ``end_kws`` (or
+    end of statement). Case-insensitive keyword match on ``text_u``."""
+    m = re.search(rf"\b{start_kw}\b", text_u)
+    if m is None:
+        return None
+    rest = text[m.end():]
+    rest_u = text_u[m.end():]
+    end = len(rest)
+    for kw in end_kws:
+        em = re.search(rf"\b{kw}\b", rest_u)
+        if em is not None:
+            end = min(end, em.start())
+    return rest[:end]
+
+
+def _split_commas(text: str) -> List[str]:
+    """Split on commas at paren depth 0."""
+    out, depth, cur = [], 0, []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return [p.strip() for p in out if p.strip()]
+
+
+_TABLE_AFTER = {
+    "select": r"\bFROM\s+(%s)",
+    "delete": r"\bFROM\s+(%s)",
+    "update": r"\bUPDATE\s+(%s)",
+    "insert": r"\bINTO\s+(%s)",
+}
+
+
+def _guards(region: str) -> Tuple[Tuple[str, ...], Dict[str, str]]:
+    cols: List[str] = []
+    lits: Dict[str, str] = {}
+    for m in _GUARD_RE.finditer(region):
+        col = m.group(1).split(".")[-1]
+        if col.lower() in _KEYWORDS:
+            continue
+        cols.append(col)
+        if m.group(2) == "=" and m.group(3) and m.group(3) != "?":
+            lits[col] = m.group(3).strip("'")
+    return tuple(dict.fromkeys(cols)), lits
+
+
+def _parse_into(st: SqlStatement) -> None:
+    text = _normalize(st.raw)
+    st.raw = text
+    u = text.upper()
+    reads: List[str] = []
+    writes: List[str] = []
+
+    if u.startswith("BEGIN"):
+        st.kind = "begin"
+        st.begin_mode = ("immediate" if "IMMEDIATE" in u
+                         else "exclusive" if "EXCLUSIVE" in u
+                         else "deferred")
+        return
+    if u.startswith(("COMMIT", "ROLLBACK", "END")):
+        st.kind = "commit"
+        return
+    if u.startswith("PRAGMA"):
+        st.kind = "pragma"
+        return
+    if u.startswith("CREATE") and " TABLE" in u.split("(")[0]:
+        st.kind = "create_table"
+        m = re.search(
+            rf"TABLE\s+(?:IF\s+NOT\s+EXISTS\s+)?({_IDENT})\s*\(", u)
+        if m:
+            # Recover original-case name from the same span.
+            st.tables = (text[m.start(1):m.end(1)],)
+            body = text[m.end():]
+            depth, end = 1, len(body)
+            for i, ch in enumerate(body):
+                depth += (ch == "(") - (ch == ")")
+                if depth == 0:
+                    end = i
+                    break
+            cols = []
+            constraint_kws = ("primary", "unique", "check", "foreign",
+                              "constraint")
+            for item in _split_commas(body[:end]):
+                first = item.split()[0] if item.split() else ""
+                if not first or first.lower() in constraint_kws:
+                    continue
+                cols.append((first, " ".join(item.split()[1:])))
+            st.schema_columns = tuple(cols)
+        return
+    if u.startswith("CREATE") and " INDEX" in u.split("(")[0]:
+        st.kind = "create_index"
+        m = re.search(rf"\bON\s+({_IDENT})\s*\(([^)]*)\)", text,
+                      re.IGNORECASE)
+        if m:
+            st.tables = (m.group(1),)
+            reads.extend(_idents(m.group(2)))
+        where = _clause(u, text, "WHERE", ())
+        if where is not None:
+            wc, lits = _guards(where)
+            st.where_columns = wc
+            st.where_literals = lits
+            reads.extend(wc)
+        st.columns_read = tuple(dict.fromkeys(reads))
+        return
+    if u.startswith("ALTER"):
+        st.kind = "alter_table"
+        m = re.search(
+            rf"ALTER\s+TABLE\s+({_IDENT})\s+ADD\s+COLUMN\s+({_IDENT})\s*(.*)",
+            text, re.IGNORECASE)
+        if m:
+            st.tables = (m.group(1),)
+            st.schema_columns = ((m.group(2), m.group(3).strip()),)
+        return
+    if u.startswith("DROP"):
+        st.kind = "drop"
+        m = re.search(rf"\b(?:TABLE|INDEX)\s+(?:IF\s+EXISTS\s+)?({_IDENT})",
+                      text, re.IGNORECASE)
+        if m:
+            st.tables = (m.group(1),)
+        return
+
+    kind = u.split(None, 1)[0].lower() if u else ""
+    if kind not in ("select", "insert", "update", "delete"):
+        st.kind = "other"
+        return
+    st.kind = kind
+
+    # Tables: the statement's own target plus any subquery FROMs.
+    tables = []
+    pat = _TABLE_AFTER[kind] % _IDENT
+    m = re.search(pat, text, re.IGNORECASE)
+    if m:
+        tables.append(m.group(1))
+    for sm in re.finditer(rf"\bFROM\s+({_IDENT})", text, re.IGNORECASE):
+        if sm.group(1) not in tables:
+            tables.append(sm.group(1))
+    st.tables = tuple(tables)
+
+    if kind == "select":
+        sel = _clause(u, text, "SELECT", ("FROM",))
+        if sel is not None:
+            for item in _split_commas(sel):
+                reads.extend(_idents(item))
+    if kind == "insert":
+        m = re.search(rf"\bINTO\s+{_IDENT}\s*\(([^)]*)\)", text,
+                      re.IGNORECASE)
+        if m:
+            writes.extend(_idents(m.group(1)))
+        cm = re.search(r"\bON\s+CONFLICT\s*\(([^)]*)\)", text,
+                       re.IGNORECASE)
+        if cm:
+            reads.extend(_idents(cm.group(1)))
+    if kind in ("update",) or (kind == "insert" and "DO UPDATE" in u):
+        set_region = _clause(u, text, "SET", ("WHERE",))
+        if set_region is not None:
+            set_off = text.index(set_region)
+            for item in _split_commas(set_region):
+                if "=" not in item:
+                    continue
+                lhs, rhs = item.split("=", 1)
+                lhs_ids = _idents(lhs)
+                if not lhs_ids:
+                    continue
+                col = lhs_ids[0]
+                writes.append(col)
+                st.set_columns = st.set_columns + (col,)
+                rhs = rhs.strip()
+                reads.extend(_idents(rhs))
+                if rhs == "?":
+                    before = text[:set_off + text[set_off:].index(item)
+                                  + item.index("=")]
+                    st.set_params[col] = before.count("?")
+                elif rhs.startswith("'") or re.fullmatch(
+                        r"\d+(\.\d+)?", rhs):
+                    st.set_literals.setdefault(col, rhs.strip("'"))
+
+    # WHERE guards: the region may be the outer statement's or (for the
+    # retention DELETE) contain a whole subquery — guards inside parens
+    # still name real columns of the named tables, so keep them.
+    where = _clause(u, text, "WHERE", ("ORDER BY", "GROUP BY"))
+    if where is not None:
+        wc, lits = _guards(where)
+        st.where_columns = wc
+        st.where_literals.update(lits)
+        reads.extend(_idents(where))
+
+    grp = _clause(u, text, "GROUP BY", ("ORDER BY", "LIMIT"))
+    if grp is not None:
+        st.group_by = tuple(_idents(grp))
+        reads.extend(st.group_by)
+    order = _clause(u, text, "ORDER BY", ("LIMIT", "OFFSET"))
+    if order is not None:
+        st.order_by = tuple(_idents(order))
+        reads.extend(st.order_by)
+    st.has_limit = re.search(r"\bLIMIT\b", u) is not None
+
+    table_names = {t.lower() for t in st.tables}
+    st.columns_read = tuple(dict.fromkeys(
+        c for c in reads if c.lower() not in table_names))
+    st.columns_written = tuple(dict.fromkeys(
+        c for c in writes if c.lower() not in table_names))
+
+
+def split_script(text: str) -> List[str]:
+    """``executescript`` payload → individual statements (top-level ';'
+    split; sqlite's dialect here has no ';' inside literals we emit)."""
+    parts, depth, cur = [], 0, []
+    in_str = False
+    for ch in text:
+        if ch == "'":
+            in_str = not in_str
+        elif not in_str:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth = max(0, depth - 1)
+            elif ch == ";" and depth == 0:
+                parts.append("".join(cur))
+                cur = []
+                continue
+        cur.append(ch)
+    parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+# ----------------------------------------------- string-expression model
+_MAX_VARIANTS = 16
+
+
+def expand_str_expr(ctx: ModuleContext, expr: ast.AST, _depth: int = 0
+                    ) -> List[Tuple[str, bool]]:
+    """Possible (text, spliced) values of a string-ish expression.
+
+    Handles the idioms the stores use: plain constants (adjacent-literal
+    concatenation is already one Constant), f-strings (``claim()``'s
+    ``{not_in}`` splice), a Name bound to a local assignment or a literal
+    for-loop target (the ``ALTER TABLE ... ADD COLUMN {col} {decl}``
+    migration loop), conditional expressions, ``+`` concatenation, and
+    ``sep.join(<literal str sequence>)`` (the ``_TASK_COLS`` select
+    lists). Anything else becomes an empty segment with spliced=True —
+    the parse stays sound, the drift check stays conservative.
+    """
+    if _depth > 6:
+        return [("", True)]
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, str):
+            return [(expr.value, False)]
+        return [("", True)]
+    if isinstance(expr, ast.JoinedStr):
+        loop = _covarying_loop(ctx, expr)
+        if loop is not None:
+            return loop
+        variants: List[Tuple[str, bool]] = [("", False)]
+        for part in expr.values:
+            if isinstance(part, ast.Constant):
+                sub = [(str(part.value), False)]
+            elif isinstance(part, ast.FormattedValue):
+                sub = expand_str_expr(ctx, part.value, _depth + 1)
+            else:  # pragma: no cover - future ast nodes
+                sub = [("", True)]
+            variants = _cross(variants, sub)
+        return variants
+    if isinstance(expr, ast.IfExp):
+        out = (expand_str_expr(ctx, expr.body, _depth + 1)
+               + expand_str_expr(ctx, expr.orelse, _depth + 1))
+        return _dedupe(out)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _cross(expand_str_expr(ctx, expr.left, _depth + 1),
+                      expand_str_expr(ctx, expr.right, _depth + 1))
+    if isinstance(expr, ast.Name):
+        bound = _resolve_name(ctx, expr)
+        if bound is not None:
+            return expand_str_expr(ctx, bound, _depth + 1)
+        loop_vals = _loop_values(ctx, expr, expr.id)
+        if loop_vals is not None:
+            return loop_vals
+        return [("", True)]
+    if (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "join"
+            and isinstance(expr.func.value, ast.Constant)
+            and isinstance(expr.func.value.value, str)
+            and len(expr.args) == 1):
+        items = _literal_str_seq(ctx, expr.args[0])
+        if items is not None:
+            return [(expr.func.value.value.join(items), False)]
+        return [("", True)]
+    return [("", True)]
+
+
+def _cross(a: List[Tuple[str, bool]], b: List[Tuple[str, bool]]
+           ) -> List[Tuple[str, bool]]:
+    out = [(x + y, sx or sy) for x, sx in a for y, sy in b]
+    return _dedupe(out)
+
+
+def _dedupe(variants: List[Tuple[str, bool]]) -> List[Tuple[str, bool]]:
+    seen, out = set(), []
+    for v in variants:
+        if v not in seen:
+            seen.add(v)
+            out.append(v)
+    return out[:_MAX_VARIANTS]
+
+
+def _resolve_name(ctx: ModuleContext, expr: ast.Name
+                  ) -> Optional[ast.AST]:
+    """The single local (or module-level) binding of ``expr``'s name."""
+    fn = ctx.enclosing_function(expr)
+    scopes: List[ast.AST] = [n for n in (fn, ctx.tree) if n is not None]
+    for scope in scopes:
+        bound = None
+        for node in ast.walk(scope):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == expr.id
+                    and node is not expr):
+                if bound is not None:
+                    return None  # ambiguous rebind
+                bound = node.value
+        if bound is not None:
+            return bound
+    return None
+
+
+def _loop_for(ctx: ModuleContext, at: ast.AST, name: str
+              ) -> Optional[ast.For]:
+    """The literal-iterable For loop binding ``name`` that encloses or
+    precedes ``at`` in its function."""
+    fn = ctx.enclosing_function(at) or ctx.tree
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.For):
+            continue
+        targets = []
+        if isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        elif isinstance(node.target, ast.Tuple):
+            targets = [e.id for e in node.target.elts
+                       if isinstance(e, ast.Name)]
+        if name in targets and isinstance(node.iter, (ast.Tuple, ast.List)):
+            return node
+    return None
+
+
+def _loop_element_values(loop: ast.For, name: str
+                         ) -> Optional[List[str]]:
+    if isinstance(loop.target, ast.Name):
+        idx = None if loop.target.id != name else -1
+    else:
+        names = [e.id if isinstance(e, ast.Name) else None
+                 for e in loop.target.elts]
+        idx = names.index(name) if name in names else None
+    if idx is None:
+        return None
+    vals = []
+    for elt in loop.iter.elts:
+        if idx == -1:
+            item = elt
+        elif isinstance(elt, (ast.Tuple, ast.List)) and idx < len(elt.elts):
+            item = elt.elts[idx]
+        else:
+            return None
+        if isinstance(item, ast.Constant) and isinstance(item.value, str):
+            vals.append(item.value)
+        else:
+            return None
+    return vals
+
+
+def _loop_values(ctx: ModuleContext, at: ast.AST, name: str
+                 ) -> Optional[List[Tuple[str, bool]]]:
+    loop = _loop_for(ctx, at, name)
+    if loop is None:
+        return None
+    vals = _loop_element_values(loop, name)
+    if vals is None:
+        return None
+    return _dedupe([(v, False) for v in vals])
+
+
+def _covarying_loop(ctx: ModuleContext, joined: ast.JoinedStr
+                    ) -> Optional[List[Tuple[str, bool]]]:
+    """All FormattedValue Names bound by ONE literal for-loop → expand
+    per loop element, not as an (incorrect) cartesian product — the
+    ``ADD COLUMN {col} {decl}`` migration idiom."""
+    names = []
+    for part in joined.values:
+        if isinstance(part, ast.FormattedValue):
+            if not isinstance(part.value, ast.Name):
+                return None
+            names.append(part.value.id)
+    if len(names) < 2:
+        return None
+    loops = {name: _loop_for(ctx, joined, name) for name in names}
+    first = loops[names[0]]
+    if first is None or any(lp is not first for lp in loops.values()):
+        return None
+    per_name = {name: _loop_element_values(first, name) for name in names}
+    if any(v is None for v in per_name.values()):
+        return None
+    n = len(next(iter(per_name.values())))
+    out: List[Tuple[str, bool]] = []
+    for i in range(n):
+        text = "".join(
+            str(part.value) if isinstance(part, ast.Constant)
+            else per_name[part.value.id][i]
+            for part in joined.values)
+        out.append((text, False))
+    return _dedupe(out)
+
+
+def _literal_str_seq(ctx: ModuleContext, expr: ast.AST
+                     ) -> Optional[List[str]]:
+    """Resolve a tuple/list of string constants: inline, via a local
+    Name, or via ``self.X`` → a class-level assignment."""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        vals = []
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                vals.append(e.value)
+            else:
+                return None
+        return vals
+    if isinstance(expr, ast.Name):
+        bound = _resolve_name(ctx, expr)
+        if bound is not None:
+            return _literal_str_seq(ctx, bound)
+        return None
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")):
+        cls = next((a for a in ctx.ancestors(expr)
+                    if isinstance(a, ast.ClassDef)), None)
+        if cls is None:
+            return None
+        for stmt in cls.body:
+            if (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == expr.attr
+                            for t in stmt.targets)):
+                return _literal_str_seq(ctx, stmt.value)
+    return None
+
+
+# ------------------------------------------------------------ extraction
+def statements_from_call(ctx: ModuleContext, call: ast.Call
+                         ) -> List[SqlStatement]:
+    """Parsed statements behind one ``.execute`` / ``.executemany`` /
+    ``.executescript`` call (possibly several: f-string variants expand
+    each branch; a script splits on ';'). Returns [] when the first
+    argument is not statically string-like."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr in EXECUTE_METHODS and call.args):
+        return []
+    variants = expand_str_expr(ctx, call.args[0])
+    out: List[SqlStatement] = []
+    seen = set()
+    for text, spliced in variants:
+        if not text.strip():
+            continue
+        pieces = (split_script(text) if call.func.attr == "executescript"
+                  else [text])
+        for piece in pieces:
+            st = SqlStatement(piece, node=call, spliced=spliced)
+            key = (st.raw, st.spliced)
+            if st.kind != "other" and key not in seen:
+                seen.add(key)
+                out.append(st)
+    return out

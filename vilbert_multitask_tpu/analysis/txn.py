@@ -1,0 +1,936 @@
+"""Transaction-scope analysis + the durable-state manifest (TXN_SURFACE.json).
+
+The atomicity tier on top of :mod:`analysis.sql`: it recovers every
+*connection scope* — a ``with self._conn() as c:`` body (or a bare
+``c = factory()`` binding) whose context manager resolves to a
+sqlite-connection factory anywhere in the project — orders the SQL
+statements executed inside it via the CFG/worklist machinery, and
+classifies the scope's transaction mode:
+
+- ``immediate``/``exclusive``: an explicit ``BEGIN IMMEDIATE``/
+  ``EXCLUSIVE`` statement opens the scope — the write lock is taken up
+  front, so a read-modify-write inside is atomic across OS processes;
+- ``deferred``: a plain ``with`` scope — pysqlite only issues the
+  implicit ``BEGIN`` before DML, so a ``SELECT`` takes no write lock and
+  DDL autocommits per-statement;
+- ``autocommit``: a connection used without ``with`` — nothing groups
+  the statements at all.
+
+From those facts it precomputes the findings the VMT128–131 rules
+(:mod:`analysis.txnrules`) re-anchor per module, and builds the
+generative ``TXN_SURFACE.json`` manifest: every durable table with its
+full migrated schema, every transaction site with mode and statement
+list, and the literal-write state machines (``jobs.status``,
+``jobs.dead_notified``) that ROADMAP item 3's multi-process queue work
+consumes as its contract.
+
+Stdlib-only, like the rest of the analysis package — the stores are
+analyzed as source, never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import json
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from vilbert_multitask_tpu.analysis.cfg import (
+    WithEnter,
+    WithExit,
+    build_cfg,
+    iter_event_nodes,
+)
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.dataflow import (
+    ForwardAnalysis,
+    iter_event_facts,
+    solve,
+)
+from vilbert_multitask_tpu.analysis.sql import (
+    EXECUTE_METHODS,
+    SqlStatement,
+    statements_from_call,
+)
+
+TXN_VERSION = 1
+MANIFEST_NAME = "TXN_SURFACE.json"
+
+_DEFAULT_RE = re.compile(r"\bDEFAULT\s+('[^']*'|-?\d+(?:\.\d+)?)", re.I)
+_SQLITE_PSEUDO_COLS = frozenset(("rowid", "oid", "_rowid_"))
+
+
+def _witness(path: str, line: int, note: str) -> dict:
+    return {"path": path, "line": line, "message": note}
+
+
+def _qualname(ctx: ModuleContext, fn: ast.AST) -> str:
+    parts = [getattr(fn, "name", "<lambda>")]
+    for anc in ctx.ancestors(fn):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(anc.name)
+    mod = ctx.rel_path[:-3].replace("/", ".")
+    return f"{mod}:{'.'.join(reversed(parts))}"
+
+
+def stmt_reads(st: SqlStatement) -> Tuple[str, ...]:
+    """Every column position a statement *reads* — the credit set the
+    dead-column direction of VMT130 and the manifest both use."""
+    seen: Dict[str, None] = {}
+    for group in (st.columns_read, st.where_columns, st.order_by,
+                  st.group_by):
+        for c in group:
+            seen.setdefault(c)
+    return tuple(seen)
+
+
+# ---------------------------------------------------------------- scopes
+class ExecSite:
+    """One ``.execute``-family call inside a connection scope."""
+
+    __slots__ = ("call", "line", "col", "statements")
+
+    def __init__(self, ctx: ModuleContext, call: ast.Call) -> None:
+        self.call = call
+        self.line = call.lineno
+        self.col = call.col_offset
+        self.statements: List[SqlStatement] = statements_from_call(ctx, call)
+
+
+class ConnScope:
+    """One connection scope: the statements one sqlite connection runs.
+
+    ``kind`` is ``"with"`` (context-managed — commits on exit) or
+    ``"bare"`` (a plain assignment from a factory — nothing commits).
+    ``mode`` is computed after site collection: ``immediate`` /
+    ``exclusive`` / ``deferred`` / ``autocommit``.
+    """
+
+    __slots__ = ("ctx", "fn_node", "function", "path", "line", "conn_var",
+                 "kind", "factory", "sites", "mode")
+
+    def __init__(self, ctx: ModuleContext, fn_node: ast.AST, line: int,
+                 conn_var: Optional[str], kind: str, factory: str) -> None:
+        self.ctx = ctx
+        self.fn_node = fn_node
+        self.function = _qualname(ctx, fn_node)
+        self.path = ctx.rel_path
+        self.line = line
+        self.conn_var = conn_var
+        self.kind = kind
+        self.factory = factory
+        self.sites: List[ExecSite] = []
+        self.mode = "deferred"
+
+    def add_site(self, ctx: ModuleContext, call: ast.Call) -> None:
+        self.sites.append(ExecSite(ctx, call))
+
+    def finalize(self) -> None:
+        self.sites.sort(key=lambda s: (s.line, s.col))
+        modes = [st.begin_mode for site in self.sites
+                 for st in site.statements if st.kind == "begin"]
+        if "exclusive" in modes:
+            self.mode = "exclusive"
+        elif "immediate" in modes:
+            self.mode = "immediate"
+        else:
+            # An explicit plain BEGIN is still deferred; a bare conn with
+            # no BEGIN at all groups nothing.
+            self.mode = "deferred" if (self.kind == "with" or modes) \
+                else "autocommit"
+
+    def entries(self) -> List[Tuple[ExecSite, SqlStatement]]:
+        return [(site, st) for site in self.sites for st in site.statements]
+
+
+class _OpenConnScopes(ForwardAnalysis):
+    """Must-open connection scopes before each event (join = ∩) — the
+    same lock-set shape ``analysis.locks`` uses, over conn withitems."""
+
+    def __init__(self, items: Dict[int, ConnScope]) -> None:
+        self.items = items
+
+    def initial(self) -> FrozenSet[int]:
+        return frozenset()
+
+    def join(self, a: FrozenSet[int], b: FrozenSet[int]) -> FrozenSet[int]:
+        return a & b
+
+    def transfer(self, event, fact: FrozenSet[int]) -> FrozenSet[int]:
+        if isinstance(event, WithEnter) and id(event.item) in self.items:
+            return fact | {id(event.item)}
+        if isinstance(event, WithExit) and id(event.item) in self.items:
+            return fact - {id(event.item)}
+        return fact
+
+
+# ------------------------------------------------------------- the flow
+class TxnFlow:
+    """Project-wide transaction facts, cached on the ProjectGraph.
+
+    Rules consume the precomputed finding lists (``rmw``,
+    ``multi_write``, ``drift``, ``claims``) filtered by their module's
+    path; the manifest builder consumes ``scopes`` / ``schema`` /
+    ``state_machines``.
+    """
+
+    def __init__(self, project) -> None:
+        self.project = project
+        self.factories: Set[str] = _factory_functions(project)
+        self.scopes: List[ConnScope] = []
+        # table -> {col: {"decl", "origin", "path", "line"}} in
+        # declaration order; witness per table is the CREATE site.
+        self.schema: Dict[str, Dict[str, dict]] = {}
+        self.table_witness: Dict[str, Tuple[str, int]] = {}
+        self.links: List[dict] = []        # read→write deps, every mode
+        self.rmw: List[dict] = []          # VMT128
+        self.multi_write: List[dict] = []  # VMT129
+        self.drift: List[dict] = []        # VMT130 (kind: unknown | dead)
+        self.claims: List[dict] = []       # VMT131
+        self.state_machines: Dict[str, Dict[str, dict]] = {}
+        self._collect_scopes()
+        self._collect_schema()
+        self._collect_links()
+        self._check_rmw()
+        self._check_multi_write()
+        self._check_drift()
+        self._check_claims()
+        self._recover_state_machines()
+
+    # ------------------------------------------------------- collection
+    def _collect_scopes(self) -> None:
+        for mod in sorted(self.project.modules.values(),
+                          key=lambda m: m.name):
+            ctx = mod.ctx
+            if "execute" not in ctx.source:
+                continue
+            for fn in ast.walk(ctx.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._scopes_in_function(ctx, fn)
+
+    def _factory_of(self, ctx: ModuleContext,
+                    expr: ast.AST) -> Optional[str]:
+        """Factory name when ``expr`` is a call producing a sqlite
+        connection: ``sqlite3.connect(...)`` directly, ``self._conn()``
+        on a discovered factory method, or a (possibly imported) factory
+        function by name — the ProjectGraph-backed resolution that lets
+        one pass cover all three stores."""
+        if not isinstance(expr, ast.Call):
+            return None
+        resolved = ctx.resolve(expr.func)
+        if resolved == "sqlite3.connect":
+            return "sqlite3.connect"
+        if isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in self.factories:
+            return expr.func.attr
+        if resolved and resolved.split(".")[-1] in self.factories:
+            return resolved.split(".")[-1]
+        return None
+
+    def _scopes_in_function(self, ctx: ModuleContext, fn: ast.AST) -> None:
+        items: Dict[int, ConnScope] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)) \
+                    and ctx.enclosing_function(node) is fn:
+                for item in node.items:
+                    fac = self._factory_of(ctx, item.context_expr)
+                    if fac is None:
+                        continue
+                    var = (item.optional_vars.id
+                           if isinstance(item.optional_vars, ast.Name)
+                           else None)
+                    items[id(item)] = ConnScope(ctx, fn, node.lineno, var,
+                                                "with", fac)
+        bare: List[Tuple[ast.Assign, ConnScope]] = []
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and ctx.enclosing_function(node) is fn
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                fac = self._factory_of(ctx, node.value)
+                if fac is not None:
+                    bare.append((node, ConnScope(
+                        ctx, fn, node.lineno, node.targets[0].id, "bare",
+                        fac)))
+        if not items and not bare:
+            return
+        claimed: Set[int] = set()
+        if items:
+            cfg = build_cfg(fn)
+            analysis = _OpenConnScopes(items)
+            facts = solve(cfg, analysis)
+            for event, fact in iter_event_facts(cfg, analysis, facts):
+                if isinstance(event, (WithEnter, WithExit)):
+                    continue
+                for node in iter_event_nodes(event):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in EXECUTE_METHODS
+                            and isinstance(node.func.value, ast.Name)):
+                        continue
+                    if id(node) in claimed:
+                        continue
+                    cands = [items[k] for k in fact
+                             if items[k].conn_var == node.func.value.id]
+                    if not cands:
+                        continue
+                    claimed.add(id(node))
+                    max(cands, key=lambda s: s.line).add_site(ctx, node)
+        for assign, scope in bare:
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in EXECUTE_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == scope.conn_var
+                        and node.lineno >= assign.lineno
+                        and id(node) not in claimed
+                        and ctx.enclosing_function(node) is fn):
+                    claimed.add(id(node))
+                    scope.add_site(ctx, node)
+        for scope in list(items.values()) + [s for _, s in bare]:
+            if scope.sites:
+                scope.finalize()
+                self.scopes.append(scope)
+
+    def _collect_schema(self) -> None:
+        for scope in self.scopes:
+            for site in scope.sites:
+                for st in site.statements:
+                    if not st.is_schema_write or not st.tables:
+                        continue
+                    table = st.tables[0]
+                    cols = self.schema.setdefault(table, {})
+                    if st.kind == "create_table":
+                        self.table_witness.setdefault(
+                            table, (scope.path, site.line))
+                    origin = ("create" if st.kind == "create_table"
+                              else "alter")
+                    for col, decl in st.schema_columns:
+                        cols.setdefault(col, {
+                            "decl": decl, "origin": origin,
+                            "path": scope.path, "line": site.line})
+
+    # ------------------------------------------------- read→write links
+    def _collect_links(self) -> None:
+        for scope in self.scopes:
+            self.links.extend(self._scope_links(scope))
+
+    def _scope_links(self, scope: ConnScope) -> List[dict]:
+        ctx = scope.ctx
+        out: List[dict] = []
+        seen: Set[Tuple[int, int, str]] = set()
+        entries = scope.entries()
+        fn_assigns = sorted(
+            (a for a in ast.walk(scope.fn_node)
+             if isinstance(a, ast.Assign)
+             and ctx.enclosing_function(a) is scope.fn_node),
+            key=lambda a: a.lineno)
+        for rsite, rst in entries:
+            if rst.kind != "select" or not rst.tables:
+                continue
+            assign = _assign_of(ctx, rsite.call)
+            if assign is None:
+                continue
+            base = _target_names(assign)
+            if not base:
+                continue
+            # Taint events: (line, names-added, witness-step), monotone.
+            taint_events: List[Tuple[int, Set[str], dict]] = []
+            tainted = set(base)
+            for a in fn_assigns:
+                if a.lineno <= rsite.line or a is assign:
+                    continue
+                if _loads(a.value) & tainted:
+                    added = _target_names(a) - tainted
+                    if added:
+                        tainted |= added
+                        taint_events.append((a.lineno, added, _witness(
+                            ctx.rel_path, a.lineno,
+                            f"`{', '.join(sorted(added))}` derived from "
+                            f"the read result")))
+            for wsite, wst in entries:
+                if not wst.is_write or wsite.line <= rsite.line:
+                    continue
+                shared = [t for t in wst.tables if t in rst.tables]
+                if not shared:
+                    continue
+                key = (rsite.line, wsite.line, shared[0])
+                if key in seen:
+                    continue
+                taint_at = set(base)
+                steps = [_witness(
+                    ctx.rel_path, rsite.line,
+                    f"SELECT on `{rst.tables[0]}` — result bound to "
+                    f"`{', '.join(sorted(base))}` (no write lock taken)")]
+                for line, added, step in taint_events:
+                    if line < wsite.line:
+                        taint_at |= added
+                        steps.append(step)
+                dep = None
+                if _loads_in_args(wsite.call) & taint_at:
+                    dep = "data"
+                else:
+                    guard = _guard_if(ctx, scope.fn_node, rsite.line,
+                                      wsite.line, taint_at)
+                    if guard is not None:
+                        dep = "control"
+                        steps.append(_witness(
+                            ctx.rel_path, guard.lineno,
+                            "read result decides whether the write "
+                            "runs (early exit guard)"))
+                if dep is None:
+                    continue
+                seen.add(key)
+                steps.append(_witness(
+                    ctx.rel_path, wsite.line,
+                    f"dependent {wst.kind.upper()} on `{shared[0]}` "
+                    f"commits here"))
+                out.append({
+                    "scope": scope, "read_site": rsite, "read": rst,
+                    "write_site": wsite, "write": wst,
+                    "table": shared[0], "dep": dep, "steps": steps})
+        return out
+
+    # ------------------------------------------------------------ rules
+    def _check_rmw(self) -> None:
+        for link in self.links:
+            scope = link["scope"]
+            if scope.mode not in ("deferred", "autocommit"):
+                continue
+            table = link["table"]
+            self.rmw.append({
+                "path": scope.path,
+                "line": link["read_site"].line,
+                "col": link["read_site"].col,
+                "message": (
+                    f"read-modify-write on `{table}` inside a "
+                    f"{scope.mode} connection scope: the SELECT takes no "
+                    f"write lock, so another process can commit between "
+                    f"it and the dependent "
+                    f"{link['write'].kind.upper()} at line "
+                    f"{link['write_site'].line} (cross-process lost "
+                    f"update / SQLITE_BUSY lock upgrade) — open the "
+                    f"scope with c.execute(\"BEGIN IMMEDIATE\") so read "
+                    f"and write share one write transaction"),
+                "flows": [list(link["steps"])],
+            })
+
+    def _check_multi_write(self) -> None:
+        for scope in self.scopes:
+            if scope.mode not in ("deferred", "autocommit"):
+                continue
+            ddl_units: Dict[str, int] = {}
+            dml_tables: Set[str] = set()
+            first_site: Dict[str, int] = {}
+            for site in scope.sites:
+                per_site: Dict[str, int] = {}
+                for st in site.statements:
+                    if not st.tables:
+                        continue
+                    t = st.tables[0]
+                    if st.is_schema_write:
+                        per_site[t] = per_site.get(t, 0) + 1
+                        first_site.setdefault(t, site.line)
+                    elif st.is_write:
+                        dml_tables.add(t)
+                        first_site.setdefault(t, site.line)
+                for t, n in per_site.items():
+                    if n == 1 and scope.ctx.in_loop(site.call):
+                        n = 2  # the looped site runs the DDL repeatedly
+                    ddl_units[t] = ddl_units.get(t, 0) + n
+            for t in sorted(set(ddl_units) | dml_tables):
+                units = ddl_units.get(t, 0) + (1 if t in dml_tables else 0)
+                if units < 2 or ddl_units.get(t, 0) == 0:
+                    continue
+                self.multi_write.append({
+                    "path": scope.path, "line": scope.line, "col": 0,
+                    "message": (
+                        f"{units} dependent writes to `{t}` split across "
+                        f"autocommit transactions in one {scope.mode} "
+                        f"scope (pysqlite autocommits each DDL "
+                        f"statement; only DML shares the implicit "
+                        f"transaction) — a crash or concurrent boot "
+                        f"between them leaves a partial migration; take "
+                        f"BEGIN IMMEDIATE so the whole migration is one "
+                        f"transaction"),
+                })
+
+    def _check_drift(self) -> None:
+        reads_by_table: Dict[str, Set[str]] = {t: set() for t in self.schema}
+        for scope in self.scopes:
+            for site in scope.sites:
+                for st in site.statements:
+                    for t in st.tables:
+                        if t in reads_by_table:
+                            reads_by_table[t].update(stmt_reads(st))
+        # Unknown columns: narrow, structurally-confident positions only.
+        seen: Set[Tuple[str, int, str]] = set()
+        for scope in self.scopes:
+            for site in scope.sites:
+                for st in site.statements:
+                    if not st.tables or st.is_schema_write \
+                            or st.kind in ("begin", "commit", "pragma"):
+                        continue
+                    if any(t not in self.schema for t in st.tables):
+                        continue  # table unknown — stay conservative
+                    known: Set[str] = set()
+                    for t in st.tables:
+                        known.update(self.schema[t])
+                    cols: Dict[str, None] = {}
+                    for group in (st.columns_read, st.columns_written,
+                                  st.where_columns, st.order_by,
+                                  st.group_by, st.set_columns):
+                        for c in group:
+                            cols.setdefault(c)
+                    for col in cols:
+                        if col in known \
+                                or col.lower() in _SQLITE_PSEUDO_COLS:
+                            continue
+                        key = (scope.path, site.line, col)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        close = difflib.get_close_matches(
+                            col, sorted(known), n=2)
+                        hint = (" — did you mean "
+                                + " or ".join(f"`{c}`" for c in close)
+                                + "?") if close else ""
+                        self.drift.append({
+                            "kind": "unknown", "path": scope.path,
+                            "line": site.line, "col": site.col,
+                            "message": (
+                                f"column `{col}` is not in the modeled "
+                                f"schema of "
+                                f"{'/'.join(sorted(st.tables))} (CREATE "
+                                f"TABLE + ALTER migrations){hint}"),
+                        })
+        for t in sorted(self.schema):
+            reads = reads_by_table.get(t, set())
+            for col, info in self.schema[t].items():
+                if col in reads:
+                    continue
+                self.drift.append({
+                    "kind": "dead", "path": info["path"],
+                    "line": info["line"], "col": 0,
+                    "message": (
+                        f"column `{t}.{col}` is never read by any SQL "
+                        f"statement in the project — dead durable state "
+                        f"(declared via {info['origin'].upper()} here); "
+                        f"read it or drop it from the schema"),
+                })
+
+    def _check_claims(self) -> None:
+        seen: Set[Tuple[str, int]] = set()
+        for scope in self.scopes:
+            entries = scope.entries()
+            for ssite, sst in entries:
+                if sst.kind != "select" or not sst.has_limit \
+                        or sst.order_by or not sst.tables:
+                    continue
+                feeds = [wst for wsite, wst in entries
+                         if wst.is_write and wsite.line > ssite.line
+                         and any(t in sst.tables for t in wst.tables)]
+                if not feeds:
+                    continue
+                key = (scope.path, ssite.line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.claims.append({
+                    "path": scope.path, "line": ssite.line,
+                    "col": ssite.col,
+                    "message": (
+                        f"competitive SELECT on `{sst.tables[0]}` uses "
+                        f"LIMIT without a total ORDER BY and feeds a "
+                        f"claim-style write — which row wins is "
+                        f"arbitrary across competing processes "
+                        f"(unfair/flappy claim order); add a total "
+                        f"ORDER BY"),
+                })
+
+    # ---------------------------------------------------- state machines
+    def _recover_state_machines(self) -> None:
+        link_by_write = {id(link["write_site"].call): link
+                         for link in self.links}
+        machines: Dict[str, Dict[str, dict]] = {}
+        for scope in self.scopes:
+            for site in scope.sites:
+                for st in site.statements:
+                    if st.kind not in ("update", "insert") \
+                            or not st.tables:
+                        continue
+                    table = st.tables[0]
+                    if table not in self.schema:
+                        continue
+                    values: Dict[str, List[str]] = {}
+                    for col, lit in st.set_literals.items():
+                        values.setdefault(col, []).append(lit)
+                    for col, idx in st.set_params.items():
+                        lits = _param_literals(scope.ctx, site.call, idx)
+                        if lits:
+                            values.setdefault(col, []).extend(lits)
+                    for col, lits in values.items():
+                        if col not in self.schema[table]:
+                            continue
+                        frm = st.where_literals.get(col)
+                        if frm is None:
+                            link = link_by_write.get(id(site.call))
+                            if link is not None:
+                                frm = link["read"].where_literals.get(col)
+                        slot = machines.setdefault(table, {}).setdefault(
+                            col, {"values": set(), "transitions": {}})
+                        for lit in lits:
+                            slot["values"].add(lit)
+                            slot["transitions"].setdefault(
+                                (frm, lit),
+                                _witness(scope.path, site.line,
+                                         f"written by {scope.function}"))
+        for table, cols in machines.items():
+            for col, slot in cols.items():
+                info = self.schema[table][col]
+                m = _DEFAULT_RE.search(info["decl"])
+                initial = m.group(1).strip("'") if m else None
+                if initial is not None:
+                    slot["values"].add(initial)
+                if len(slot["values"]) < 2:
+                    continue
+                self.state_machines.setdefault(table, {})[col] = {
+                    "initial": initial,
+                    "values": sorted(slot["values"]),
+                    "transitions": [
+                        {"from": frm, "to": to, "witness": w}
+                        for (frm, to), w in sorted(
+                            slot["transitions"].items(),
+                            key=lambda kv: (kv[0][0] or "", kv[0][1]))],
+                }
+
+
+def txn_flow(project) -> TxnFlow:
+    flow = getattr(project, "_txn_flow", None)
+    if flow is None:
+        flow = TxnFlow(project)
+        project._txn_flow = flow
+    return flow
+
+
+# ------------------------------------------------------------- helpers
+def _factory_functions(project) -> Set[str]:
+    """Names of functions that return a ``sqlite3.connect`` result —
+    the connection factories scope detection resolves against,
+    project-wide (all three stores use the ``_conn`` idiom)."""
+    names: Set[str] = set()
+    for mod in project.modules.values():
+        ctx = mod.ctx
+        if "connect" not in ctx.source:
+            continue
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            conn_names: Set[str] = set()
+            returns_conn = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and ctx.resolve(node.func) == "sqlite3.connect":
+                    parent = ctx.parent(node)
+                    if isinstance(parent, ast.Return):
+                        returns_conn = True
+                    elif isinstance(parent, ast.Assign):
+                        for t in parent.targets:
+                            if isinstance(t, ast.Name):
+                                conn_names.add(t.id)
+            if conn_names and not returns_conn:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Return) \
+                            and isinstance(node.value, ast.Name) \
+                            and node.value.id in conn_names:
+                        returns_conn = True
+                        break
+            if returns_conn:
+                names.add(fn.name)
+    return names
+
+
+def _assign_of(ctx: ModuleContext, node: ast.AST) -> Optional[ast.Assign]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.Assign):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+    return None
+
+
+def _target_names(assign: ast.Assign) -> Set[str]:
+    names: Set[str] = set()
+    for t in assign.targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                names.add(n.id)
+    return names
+
+
+def _loads(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _loads_in_args(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for arg in list(call.args[1:]) + [kw.value for kw in call.keywords]:
+        out |= _loads(arg)
+    return out
+
+
+def _guard_if(ctx: ModuleContext, fn: ast.AST, read_line: int,
+              write_line: int, tainted: Set[str]) -> Optional[ast.If]:
+    """An ``if`` between read and write whose test reads the tainted
+    names and whose body can exit the function — the control dependency
+    shape of ``if row is None: return`` / ``if row: return row[0]``."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If) \
+                or not read_line < node.lineno <= write_line:
+            continue
+        if not _loads(node.test) & tainted:
+            continue
+        for sub in node.body + node.orelse:
+            for n in ast.walk(sub):
+                if isinstance(n, (ast.Return, ast.Raise)):
+                    return node
+    return None
+
+
+def _param_literals(ctx: ModuleContext, call: ast.Call,
+                    idx: int) -> Optional[List[str]]:
+    """Literal values that can flow into the ``idx``-th ``?`` of an
+    execute call's parameter tuple — the python side of a
+    ``SET col=?`` literal write."""
+    if len(call.args) < 2:
+        return None
+    params = call.args[1]
+    if not isinstance(params, (ast.Tuple, ast.List)):
+        return None
+    if any(isinstance(e, ast.Starred) for e in params.elts[:idx + 1]):
+        return None
+    if idx >= len(params.elts):
+        return None
+    return _const_values(ctx, params.elts[idx])
+
+
+def _const_values(ctx: ModuleContext, expr: ast.AST,
+                  _depth: int = 0) -> Optional[List[str]]:
+    if _depth > 4:
+        return None
+    if isinstance(expr, ast.Constant):
+        v = expr.value
+        if isinstance(v, bool):
+            return [str(int(v))]
+        if isinstance(v, (int, float, str)):
+            return [str(v)]
+        return None
+    if isinstance(expr, ast.IfExp):
+        a = _const_values(ctx, expr.body, _depth + 1)
+        b = _const_values(ctx, expr.orelse, _depth + 1)
+        if a is not None and b is not None:
+            return sorted(set(a + b))
+        return None
+    if isinstance(expr, ast.Name):
+        from vilbert_multitask_tpu.analysis.sql import _resolve_name
+
+        bound = _resolve_name(ctx, expr)
+        if bound is not None:
+            return _const_values(ctx, bound, _depth + 1)
+    return None
+
+
+# ------------------------------------------------------------- manifest
+def build_txn_surface(project) -> dict:
+    """The durable-state manifest as a JSON-ready dict. Deterministic:
+    no timestamps, stable ordering — byte-identical output for an
+    unchanged tree is what makes ``txn --check`` a meaningful gate."""
+    flow = txn_flow(project)
+    tables: Dict[str, dict] = {}
+    for t in sorted(flow.schema):
+        w = flow.table_witness.get(t)
+        tables[t] = {
+            "columns": [
+                {"name": c, "decl": i["decl"], "origin": i["origin"],
+                 "witness": _witness(
+                     i["path"], i["line"],
+                     f"declared via {i['origin'].upper()}")}
+                for c, i in flow.schema[t].items()],
+            "witness": (_witness(w[0], w[1], "CREATE TABLE site")
+                        if w else None),
+        }
+    sites = []
+    for scope in sorted(flow.scopes,
+                        key=lambda s: (s.path, s.line, s.function)):
+        groups: Dict[Tuple[str, Tuple[str, ...]], dict] = {}
+        for site in scope.sites:
+            for st in site.statements:
+                key = (st.kind, st.tables)
+                g = groups.setdefault(key, {
+                    "kind": st.kind, "line": site.line,
+                    "tables": list(st.tables), "reads": set(),
+                    "writes": set(), "spliced": False})
+                g["line"] = min(g["line"], site.line)
+                g["reads"].update(stmt_reads(st))
+                g["writes"].update(st.columns_written)
+                g["spliced"] = g["spliced"] or st.spliced
+        stmts = [{
+            "kind": g["kind"], "line": g["line"], "tables": g["tables"],
+            "reads": sorted(g["reads"]), "writes": sorted(g["writes"]),
+            "spliced": g["spliced"],
+        } for g in sorted(groups.values(),
+                          key=lambda g: (g["line"], g["kind"],
+                                         tuple(g["tables"])))]
+        sites.append({
+            "function": scope.function, "path": scope.path,
+            "line": scope.line, "kind": scope.kind, "mode": scope.mode,
+            "factory": scope.factory, "statements": stmts,
+        })
+    return {
+        "version": TXN_VERSION,
+        "generator": "vmtlint txn",
+        "tables": tables,
+        "txn_sites": sites,
+        "state_machines": flow.state_machines,
+        "counts": {
+            "tables": len(tables),
+            "txn_sites": len(sites),
+            "statements": sum(len(s["statements"]) for s in sites),
+        },
+    }
+
+
+def render_txn_surface(surface: dict) -> str:
+    return json.dumps(surface, indent=2, sort_keys=True) + "\n"
+
+
+# ---------------------------------------------------------------- check
+def diff_txn_surface(committed: Optional[dict], fresh: dict) -> List[str]:
+    """Human-readable drift between the committed manifest and a fresh
+    build — schema/site-level first (the actionable story), then the
+    metadata fallback."""
+    if committed is None:
+        return [f"{MANIFEST_NAME} missing — run `vmtlint txn` and "
+                f"commit it"]
+    msgs: List[str] = []
+    if committed.get("version") != fresh.get("version"):
+        msgs.append(f"manifest version {committed.get('version')} != "
+                    f"generator version {fresh.get('version')}")
+    ct = sorted(committed.get("tables", {}))
+    ft = sorted(fresh.get("tables", {}))
+    if ct != ft:
+        msgs.append(f"durable tables drifted: committed {ct} vs tree {ft}")
+    for t in sorted(set(ct) & set(ft)):
+        cc = [c["name"] for c in committed["tables"][t]["columns"]]
+        fc = [c["name"] for c in fresh["tables"][t]["columns"]]
+        if cc != fc:
+            msgs.append(f"schema of `{t}` drifted: committed {cc} vs "
+                        f"tree {fc}")
+    cs = [f"{s['function']}@{s['mode']}"
+          for s in committed.get("txn_sites", [])]
+    fs = [f"{s['function']}@{s['mode']}"
+          for s in fresh.get("txn_sites", [])]
+    if cs != fs:
+        gone = sorted(set(cs) - set(fs))
+        new = sorted(set(fs) - set(cs))
+        detail = "; ".join(
+            p for p in (f"gone: {', '.join(gone)}" if gone else "",
+                        f"new: {', '.join(new)}" if new else "")
+            if p) or "mode/order changed"
+        msgs.append(f"transaction sites drifted ({detail})")
+    cm = _machine_edges(committed)
+    fm = _machine_edges(fresh)
+    if cm != fm:
+        msgs.append(f"state machines drifted: committed edges "
+                    f"{sorted(cm - fm) + sorted(fm - cm)} changed")
+    if not msgs and committed != fresh:
+        msgs.append("manifest metadata drifted (witness lines moved?) — "
+                    "regenerate with `vmtlint txn`")
+    return msgs
+
+
+def _machine_edges(surface: dict) -> Set[Tuple[str, str, str, str]]:
+    out: Set[Tuple[str, str, str, str]] = set()
+    for table, cols in surface.get("state_machines", {}).items():
+        for col, m in cols.items():
+            for tr in m.get("transitions", []):
+                out.add((table, col, tr.get("from") or "*", tr["to"]))
+    return out
+
+
+# ---------------------------------------------------------------- sarif
+def render_txn_surface_sarif(surface: dict) -> str:
+    """SARIF view: one informational result per transaction site (its
+    statements as a codeFlow) and one per recovered state machine —
+    the same schema the rule findings use."""
+    results = []
+    for site in surface["txn_sites"]:
+        loc = _witness(site["path"], site["line"],
+                       f"{site['mode']} scope via {site['factory']}()")
+        steps = [loc] + [
+            _witness(site["path"], st["line"],
+                     f"{st['kind']} on {', '.join(st['tables']) or '-'}")
+            for st in site["statements"]]
+        results.append({
+            "ruleId": "TXN-SURFACE",
+            "level": "note",
+            "message": {"text": (
+                f"transaction site `{site['function']}` "
+                f"(mode {site['mode']}, {len(site['statements'])} "
+                f"statement group(s))")},
+            "locations": [_sarif_loc(loc)],
+            "codeFlows": [_sarif_flow(steps)],
+        })
+    for table in sorted(surface.get("state_machines", {})):
+        for col, m in surface["state_machines"][table].items():
+            steps = [tr["witness"] for tr in m["transitions"]]
+            if not steps:
+                continue
+            edges = ", ".join(
+                f"{tr.get('from') or '*'}→{tr['to']}"
+                for tr in m["transitions"])
+            results.append({
+                "ruleId": "TXN-STATE-MACHINE",
+                "level": "note",
+                "message": {"text": (
+                    f"`{table}.{col}` state machine: {edges}")},
+                "locations": [_sarif_loc(steps[0])],
+                "codeFlows": [_sarif_flow(steps)],
+            })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "vmtlint-txn",
+                "informationUri": "",
+                "rules": [
+                    {"id": "TXN-SURFACE",
+                     "shortDescription": {
+                         "text": "transaction-site manifest witness"}},
+                    {"id": "TXN-STATE-MACHINE",
+                     "shortDescription": {
+                         "text": "durable-state machine witness"}},
+                ],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def _sarif_loc(w: dict) -> dict:
+    return {"physicalLocation": {
+        "artifactLocation": {"uri": w["path"]},
+        "region": {"startLine": max(1, int(w.get("line", 1)))}},
+        "message": {"text": w.get("message", "")}}
+
+
+def _sarif_flow(steps: List[dict]) -> dict:
+    return {"threadFlows": [{"locations": [
+        {"location": _sarif_loc(s)} for s in steps]}]}

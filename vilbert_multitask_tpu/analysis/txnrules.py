@@ -1,0 +1,142 @@
+"""VMT128–131: SQL-transaction atomicity rules over the txn tier.
+
+The durable stores (`serve/queue.py`, `serve/db.py`, `obs/fleet.py`) are
+the one piece of state shared across OS processes once ROADMAP item 3
+goes horizontal, and sqlite only makes cross-process read-modify-write
+atomic when the scope takes the write lock *before* the read (``BEGIN
+IMMEDIATE``). These rules re-anchor the findings
+:class:`analysis.txn.TxnFlow` precomputes project-wide — the same
+cached-flow consumption shape as the VMT119/120 lock rules.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.core import Finding, Rule
+from vilbert_multitask_tpu.analysis.locks import _Anchor
+from vilbert_multitask_tpu.analysis.txn import txn_flow
+
+
+class RmwDeferredTxn(Rule):
+    """SELECT feeding a dependent same-table write without the write lock.
+
+    The live counterexample that motivated the tier: ``nack()`` read
+    ``attempts`` and wrote a dependent ``status`` under a deferred
+    transaction while ``claim()`` in the same file took BEGIN IMMEDIATE —
+    two worker processes sharing the db either lose one update or die on
+    the SQLITE_BUSY lock upgrade. The witness chain (read → dataflow →
+    write) renders as SARIF codeFlows.
+    """
+
+    id = "VMT128"
+    name = "rmw-deferred-txn"
+    severity = "error"
+    description = ("SELECT whose result feeds a later write on the same "
+                   "table inside a deferred or absent transaction — a "
+                   "cross-process lost update / SQLITE_BUSY upgrade hazard")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = txn_flow(ctx.project)
+        for e in flow.rmw:
+            if e["path"] != ctx.rel_path:
+                continue
+            f = self.finding(ctx, _Anchor(e["line"], e["col"]),
+                             e["message"])
+            f.flows = [list(chain) for chain in e["flows"]]
+            yield f
+
+
+class MultiWriteNoTxn(Rule):
+    """Dependent same-table writes split across autocommit statements.
+
+    pysqlite autocommits every DDL statement individually (since 3.6 DDL
+    neither opens nor commits a transaction) — so a CREATE + ALTER
+    migration run in a plain ``with`` scope is N separate transactions,
+    and two processes booting at once race the PRAGMA-guarded ALTERs.
+    """
+
+    id = "VMT129"
+    name = "multi-write-no-txn"
+    severity = "error"
+    description = ("dependent writes to the same table split across "
+                   "autocommit transactions (schema DDL autocommits "
+                   "per-statement) — partial migration on crash or "
+                   "concurrent boot")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = txn_flow(ctx.project)
+        for e in flow.multi_write:
+            if e["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])
+
+
+class SqlSchemaDrift(Rule):
+    """Query columns vs the modeled schema — the SQL twin of VMT122.
+
+    Two directions: a column referenced by a statement that no CREATE
+    TABLE or ALTER migration declares (typo → OperationalError at
+    runtime, with did-you-mean), and a declared column never read by any
+    statement in the project (dead durable state). Like VMT122, the dead
+    direction needs whole-project evidence, so ``--changed`` subset scans
+    suppress it via ``partial_scan``.
+    """
+
+    id = "VMT130"
+    name = "sql-schema-drift"
+    severity = "warning"
+    description = ("SQL column not declared by any modeled CREATE/ALTER "
+                   "(typo detector with did-you-mean), or a declared "
+                   "column never read anywhere (dead durable state)")
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Set by the --changed driver: a subset scan cannot prove a column
+        # is read *nowhere*, so the dead-column direction is suppressed.
+        self.partial_scan = False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = txn_flow(ctx.project)
+        for e in flow.drift:
+            if e["path"] != ctx.rel_path:
+                continue
+            if e["kind"] == "dead" and self.partial_scan:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])
+
+
+class NondeterministicClaim(Rule):
+    """Competitive SELECT-for-claim without a total ORDER BY.
+
+    A claim-style read (``LIMIT`` feeding a write on the same table)
+    without a total ordering lets sqlite pick an arbitrary row per
+    process — claim order flaps across the fleet and starves fairness,
+    exactly what ROADMAP item 3(a) ("safe and fair") forbids.
+    """
+
+    id = "VMT131"
+    name = "nondeterministic-claim"
+    severity = "warning"
+    description = ("SELECT ... LIMIT without a total ORDER BY feeding a "
+                   "claim-style write — arbitrary cross-process claim "
+                   "order")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = txn_flow(ctx.project)
+        for e in flow.claims:
+            if e["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])

@@ -25,6 +25,11 @@ class ResultStore:
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
         with self._conn() as c:
+            # One write transaction for the whole boot migration: DDL
+            # autocommits per-statement under the implicit mode, so a crash
+            # or concurrent boot mid-loop would leave a half-migrated
+            # schema (and race the ALTERs below).
+            c.execute("BEGIN IMMEDIATE")
             c.execute(
                 """CREATE TABLE IF NOT EXISTS tasks (
                     unique_id INTEGER PRIMARY KEY,
@@ -60,8 +65,11 @@ class ResultStore:
                               ("edited", "INTEGER DEFAULT 0")):
                 try:
                     c.execute(f"ALTER TABLE tasks ADD COLUMN {col} {decl}")
-                except sqlite3.OperationalError:
-                    pass  # already present
+                except sqlite3.OperationalError as e:
+                    # Only the idempotent-rerun case is expected; anything
+                    # else (locked, corrupt, disk) must surface.
+                    if "duplicate column" not in str(e).lower():
+                        raise
             # Seed/refresh the task catalog from the typed registry (replaces
             # the reference's hand-entered admin rows, demo/models.py:4-20).
             # The registry is the source of truth on boot — EXCEPT for rows
@@ -171,6 +179,11 @@ class ResultStore:
         """
         now = time.time()
         with self._conn() as c:
+            # The dedup probe below is a read-modify-write: without the
+            # write lock, two redeliveries of the same job could both miss
+            # the probe and race the INSERT (one dies on the qa_by_job
+            # unique index instead of reusing the row).
+            c.execute("BEGIN IMMEDIATE")
             if queue_job_id is not None:
                 row = c.execute(
                     "SELECT id FROM question_answers WHERE queue_job_id=?",

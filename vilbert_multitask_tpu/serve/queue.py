@@ -62,6 +62,11 @@ class DurableQueue:
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
         with self._conn() as c:
+            # One write transaction for create + index + migrations: DDL
+            # autocommits per-statement under the implicit mode, so two
+            # processes booting at once would race the PRAGMA-guarded
+            # ALTERs below (the loser dies on "duplicate column").
+            c.execute("BEGIN IMMEDIATE")
             c.execute(
                 """CREATE TABLE IF NOT EXISTS jobs (
                     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -193,6 +198,12 @@ class DurableQueue:
         Returns the resulting status ('pending' or 'dead').
         """
         with self._conn() as c:
+            # Take the write lock before reading `attempts`: under the
+            # deferred default the SELECT is lock-free, so a concurrent
+            # process could claim-and-charge this job between our read and
+            # the dependent status write (lost update / SQLITE_BUSY
+            # upgrade). Same discipline as claim()/pop_dead_letters().
+            c.execute("BEGIN IMMEDIATE")
             row = c.execute(
                 "SELECT attempts FROM jobs WHERE id=?", (job_id,)
             ).fetchone()

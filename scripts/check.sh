@@ -98,6 +98,13 @@ echo "== fleet smoke (two processes, one spine: merged metrics + stitched trace)
 JAX_PLATFORMS=cpu python scripts/fleet_smoke.py \
   --out /tmp/FLEET_SMOKE.json || fail=1
 
+echo "== AOT smoke (two boots, one executable cache: warm boot in seconds) =="
+# Two fresh-process tiny boots sharing one AOT + XLA cache dir pair. Gate:
+# the second boot deserializes every warmup program (zero trace+compiles,
+# zero fallbacks) and its wall clock is <50% of the cold boot.
+JAX_PLATFORMS=cpu python scripts/aot_smoke.py \
+  --out /tmp/AOT_SMOKE.json || fail=1
+
 echo "== perf ledger (newest entries vs trailing-window baseline) =="
 # The smokes above appended their entries; regress fails the run. A
 # fresh clone has no history yet — --tolerate-empty keeps empty and

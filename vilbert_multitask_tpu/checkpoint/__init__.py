@@ -12,17 +12,21 @@ from vilbert_multitask_tpu.checkpoint.convert import (
     to_torch_state_dict,
 )
 from vilbert_multitask_tpu.checkpoint.store import (
+    AsyncRestore,
     convert_and_save,
     restore_params,
+    restore_params_async,
     save_params,
 )
 
 __all__ = [
+    "AsyncRestore",
     "build_name_map",
     "convert_and_save",
     "convert_torch_state_dict",
     "load_torch_checkpoint",
     "restore_params",
+    "restore_params_async",
     "save_params",
     "to_torch_state_dict",
 ]

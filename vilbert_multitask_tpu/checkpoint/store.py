@@ -63,6 +63,54 @@ def restore_params(path: str, *, mesh=None, like: Optional[Any] = None,
     return params
 
 
+class AsyncRestore:
+    """Handle on a background :func:`restore_params` — ``join()`` returns
+    the restored tree (re-raising any restore failure) and reports how
+    long the restore ran."""
+
+    def __init__(self, thread, box: dict):
+        self._thread = thread
+        self._box = box
+
+    def join(self) -> Any:
+        self._thread.join()
+        if "error" in self._box:
+            raise self._box["error"]
+        return self._box["params"]
+
+    @property
+    def seconds(self) -> float:
+        """Wall time of the restore itself (valid after join())."""
+        return self._box.get("seconds", 0.0)
+
+
+def restore_params_async(path: str, *, mesh=None, dtype=None) -> AsyncRestore:
+    """:func:`restore_params` on a background thread.
+
+    Boot overlap (engine/aotcache.py): the checkpoint read + host cast +
+    device upload touch disk/network/PCIe while the AOT executable cache
+    deserializes compiled programs — disjoint resources, so the two
+    longest boot phases run concurrently instead of back to back.
+    """
+    import threading
+    import time
+
+    box: dict = {}
+
+    def _run() -> None:
+        t0 = time.perf_counter()
+        try:
+            box["params"] = restore_params(path, mesh=mesh, dtype=dtype)
+        except BaseException as e:  # noqa: BLE001 — joined and re-raised
+            box["error"] = e
+        box["seconds"] = time.perf_counter() - t0
+
+    thread = threading.Thread(target=_run, daemon=True,
+                              name="checkpoint-restore")
+    thread.start()
+    return AsyncRestore(thread, box)
+
+
 def save_train_state(path: str, state: Any) -> None:
     """Save a full TrainState (step/params/opt_state/rng) with Orbax.
 

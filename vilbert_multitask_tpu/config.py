@@ -286,6 +286,18 @@ class EngineConfig:
     # restarts and bench attempts skip the ~15s/bucket compile after the
     # first boot on a given chip generation. None → JAX default (off).
     compilation_cache_dir: str | None = None
+    # Floor (seconds) below which XLA skips persisting a compilation to
+    # compilation_cache_dir (jax_persistent_cache_min_compile_time_secs).
+    # None → auto: 0.0 when the AOT cache is enabled (the small per-bucket
+    # programs that dominate warmup count must persist too), else the JAX
+    # default of 2.0.
+    persistent_cache_min_compile_secs: float | None = None
+    # AOT executable cache (engine/aotcache.py): serialized compiled
+    # programs keyed by COMPILE_SURFACE.json record keys + a compatibility
+    # fingerprint, stored next to the checkpoint. Warm boots deserialize
+    # instead of trace+compile; misses compile and backfill. None → off.
+    # serve/app.py defaults it next to the checkpoint when one is given.
+    aot_cache_dir: str | None = None
     # Compile shape buckets concurrently at warmup — XLA compilation is C++
     # and releases the GIL, so 5 buckets warm in ~the longest single compile.
     parallel_warmup: bool = True

@@ -1,10 +1,13 @@
 """Inference engine: bucketed jit runtime, label store, per-task decoders."""
 
+from vilbert_multitask_tpu.engine.aotcache import AotCache, compile_fingerprint
 from vilbert_multitask_tpu.engine.decode import ImageMeta, TaskResult
 from vilbert_multitask_tpu.engine.labels import LabelMapStore
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine, PreparedRequest
 
 __all__ = [
+    "AotCache",
+    "compile_fingerprint",
     "ImageMeta",
     "TaskResult",
     "LabelMapStore",

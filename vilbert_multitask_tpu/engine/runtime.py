@@ -51,6 +51,7 @@ from vilbert_multitask_tpu.config import (
     TASK_REGISTRY,
     TaskSpec,
 )
+from vilbert_multitask_tpu.engine import aotcache
 from vilbert_multitask_tpu.engine import decode as dec
 from vilbert_multitask_tpu.engine.labels import LabelMapStore
 from vilbert_multitask_tpu.features.pipeline import (
@@ -93,19 +94,134 @@ from vilbert_multitask_tpu.text.wordpiece import FullTokenizer
 _cache_enabled_for: Optional[str] = None
 
 
-def _enable_compilation_cache(path: str) -> None:
+def _enable_compilation_cache(path: str,
+                              min_compile_secs: float = 2.0) -> None:
     """Turn on JAX's persistent compilation cache (process-global, so set
-    once; a second engine with a different path keeps the first's — JAX has
-    one cache per process)."""
+    once; JAX has one cache per process). A second engine requesting a
+    DIFFERENT path keeps the first's — but loudly: the conflict is recorded
+    so a misconfigured pool doesn't silently share (or split) cache state.
+    ``min_compile_secs`` is the persistence floor
+    (jax_persistent_cache_min_compile_time_secs): compilations faster than
+    it are never written — 0.0 persists everything, which is what the AOT
+    cache wants (the small per-bucket programs dominate warmup COUNT)."""
     global _cache_enabled_for
-    if _cache_enabled_for is not None:
-        return
     import os
 
+    path = os.path.abspath(path)
+    if _cache_enabled_for is not None:
+        if _cache_enabled_for != path:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "compilation cache already enabled for %s; ignoring "
+                "request for %s (JAX has one persistent cache per process)",
+                _cache_enabled_for, path)
+            obs.record_event("compile_cache_path_conflict",
+                             active=_cache_enabled_for, requested=path)
+        return
     os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
     _cache_enabled_for = path
+
+
+class _AotProgram:
+    """One compiled program behind a manifest record key, resolved lazily.
+
+    The forward builders run under ``_compile_lock`` and must stay cheap —
+    that lock is what lets parallel warmup overlap bucket compiles — so
+    when the AOT cache is on they install THIS wrapper instead of doing
+    any cache or compile work inline. Resolution happens at the first
+    call, under a per-program lock (concurrent buckets still resolve in
+    parallel): deserialize the cached executable on a hit, or
+    ``fwd.lower(*abstract_args).compile()`` on a miss and backfill the
+    cache with the serialized result.
+
+    A deserialized executable is proven by its first successful call. If
+    that first call fails (an executable serialized against a world the
+    fingerprint failed to distinguish), the wrapper permanently falls
+    back to the plain jitted forward and counts the recompile. After the
+    first proven call errors propagate unwrapped — transient device
+    failures must reach the breaker/degrade machinery, not be masked as
+    cache fallbacks.
+    """
+
+    def __init__(self, engine: "InferenceEngine", family: str, bucket: int,
+                 attn: bool, fwd, rec_key: str, model_gen: int):
+        self._engine = engine
+        self._family = family
+        self._bucket = bucket
+        self._attn = attn
+        self._fwd = fwd
+        self.record_key = rec_key
+        self._model_gen = model_gen
+        self._lock = threading.Lock()
+        self._fn = None
+        self._proven = False
+        self.from_cache = False
+        self.fell_back = False
+
+    @property
+    def resolved(self) -> bool:
+        return self._fn is not None
+
+    def ensure(self, load_only: bool = False) -> Optional[str]:
+        """Resolve the callable: ``"hit"`` (deserialized from the cache),
+        ``"compiled"`` (traced+compiled, cache backfilled), or None when
+        ``load_only`` and the cache missed (nothing compiled — the caller
+        decides whether to pay the compile)."""
+        with self._lock:
+            if self._fn is not None:
+                return "hit" if self.from_cache else "compiled"
+            eng = self._engine
+            t0 = time.perf_counter()
+            loaded = eng._aot.load(self.record_key,
+                                   model_gen=self._model_gen,
+                                   program=self._family)
+            if loaded is not None:
+                eng.book_boot_time("cache_load_s",
+                                   time.perf_counter() - t0)
+                self._fn = loaded
+                self.from_cache = True
+                return "hit"
+            if load_only:
+                return None
+            t0 = time.perf_counter()
+            args = eng._abstract_forward_args(self._family, self._bucket)
+            compiled = self._fwd.lower(*args).compile()
+            dt = time.perf_counter() - t0
+            _COMPILES.inc(program=self._family)
+            aotcache.record_compile_ms(dt * 1e3)
+            eng.book_boot_time("compile_s", dt)
+            eng._aot.store(self.record_key, compiled,
+                           model_gen=self._model_gen)
+            self._fn = compiled
+            return "compiled"
+
+    def __call__(self, *args):
+        self.ensure()
+        fn = self._fn
+        if self._proven:
+            return fn(*args)
+        try:
+            out = fn(*args)
+        except Exception as e:  # noqa: BLE001 — only the unproven
+            # deserialized-executable case is handled; everything else
+            # (including compile errors from ensure's lower) propagates to
+            # the dispatch funnel's degrade/breaker machinery.
+            if not self.from_cache:
+                raise
+            obs.record_event("aot_cache_exec_fallback",
+                             key=self.record_key, error=repr(e))
+            with self._lock:
+                self._fn = self._fwd
+                self.from_cache = False
+                self.fell_back = True
+            _COMPILES.inc(program=self._family)
+            out = self._fwd(*args)
+        self._proven = True
+        return out
 
 
 @dataclasses.dataclass
@@ -148,6 +264,7 @@ class InferenceEngine:
         mesh=None,
         seed: int = 0,
         replica_id: Optional[str] = None,
+        aot_cache: Optional[aotcache.AotCache] = None,
     ):
         self.cfg = cfg or FrameworkConfig()
         # Replica identity (serve/pool.py): None for standalone engines.
@@ -208,7 +325,18 @@ class InferenceEngine:
         )
         self.mesh = mesh
         if ecfg.compilation_cache_dir:
-            _enable_compilation_cache(ecfg.compilation_cache_dir)
+            min_secs = ecfg.persistent_cache_min_compile_secs
+            if min_secs is None:
+                # Auto: with the AOT cache on, persist EVERY compile —
+                # warmup count is dominated by small per-bucket programs
+                # the 2.0 s JAX default would skip.
+                min_secs = 0.0 if ecfg.aot_cache_dir else 2.0
+            _enable_compilation_cache(ecfg.compilation_cache_dir, min_secs)
+        # Boot-phase timing split (restore_s is stamped by the serving
+        # layer that owns the checkpoint read; cache_load_s/compile_s
+        # accumulate as programs resolve; upload_s below).
+        self.boot_times: Dict[str, float] = {}
+        self._boot_lock = threading.Lock()
         # Task-id → label-head gather table for the fused decode program
         # (index 1 = the GQA head, 0 = the VQA head): a static python tuple
         # the jitted _fused_bundle embeds as a tiny constant.
@@ -229,9 +357,25 @@ class InferenceEngine:
             with jax.transfer_guard("allow"):
                 boot_key = jax.random.PRNGKey(seed)
             params = self.init_params(boot_key)
+        t_up = time.perf_counter()
         params = self._place_params(params)
         jax.block_until_ready(params)
         self.params = params
+        self.book_boot_time("upload_s", time.perf_counter() - t_up)
+        # AOT executable cache (engine/aotcache.py): a shared instance from
+        # the serving layer (one per pool, prefetched during restore) wins;
+        # otherwise built here from the config knob. Constructed AFTER the
+        # params publish so the fingerprint records whether this engine
+        # actually serves fused head slabs.
+        if aot_cache is not None:
+            self._aot: Optional[aotcache.AotCache] = aot_cache
+        elif ecfg.aot_cache_dir:
+            self._aot = aotcache.AotCache(
+                ecfg.aot_cache_dir,
+                aotcache.compile_fingerprint(
+                    self.cfg, mesh=mesh, heads=self.head_slabs is not None))
+        else:
+            self._aot = None
         # keyed ('batched'|'rows', bucket, collect_attention, model_gen) —
         # see _forward / _forward_rows
         self._compiled: Dict[Tuple[str, int, bool, int], callable] = {}
@@ -582,7 +726,6 @@ class InferenceEngine:
         with self._compile_lock:
             if key in self._compiled:
                 return self._compiled[key]
-            _COMPILES.inc(program="batched")
             model = self.model
             engine = self
 
@@ -590,8 +733,9 @@ class InferenceEngine:
             def fwd(params, heads, batch, attn=collect_attention):
                 return engine._apply_heads(model, params, heads, batch, attn)
 
-            self._compiled[key] = fwd
-            return fwd
+            fn = self._aot_resolve("batched", bucket, collect_attention, fwd)
+            self._compiled[key] = fn
+            return fn
 
     def _forward_rows(self, bucket: int, collect_attention: bool):
         """Row-slab program (the single-device serving path): image rows
@@ -611,7 +755,6 @@ class InferenceEngine:
         with self._compile_lock:
             if key in self._compiled:
                 return self._compiled[key]
-            _COMPILES.inc(program="rows")
             model = self.model
             engine = self
             donate = (("pack",)
@@ -632,8 +775,121 @@ class InferenceEngine:
                 )
                 return engine._apply_heads(model, params, heads, batch, attn)
 
-            self._compiled[key] = fwd
+            fn = self._aot_resolve("rows", bucket, collect_attention, fwd)
+            self._compiled[key] = fn
+            return fn
+
+    def _aot_resolve(self, family: str, bucket: int, attn: bool, fwd):
+        """What the builders install under their compile key. Without the
+        AOT cache: the plain jitted forward, counted as a compile here
+        (first call traces+compiles — the pre-cache behavior, unchanged).
+        With it: an :class:`_AotProgram` wrapper; the compile counter
+        moves to the wrapper's resolution, so ``vmt_engine_compiles_total``
+        keeps meaning REAL compiles. Runs under ``_compile_lock`` — no IO,
+        no compile, just key formatting."""
+        if self._aot is None:
+            _COMPILES.inc(program=family)
             return fwd
+        ecfg = self.cfg.engine
+        rec = aotcache.record_key(
+            family, bucket, ecfg.param_dtype, ecfg.fused_task_heads,
+            aotcache.topology_id(self.cfg.mesh), attn)
+        return _AotProgram(self, family, bucket, attn, fwd, rec,
+                           self._model_gen)
+
+    def _abstract_forward_args(self, family: str, bucket: int):
+        """ShapeDtypeStruct argument trees for ``fwd.lower()`` — exactly
+        the live call's shapes/dtypes (and, under a mesh, shardings), so
+        the AOT-compiled executable binds to what dispatch actually ships.
+        The static ``attn`` argument keeps its closure default, so only
+        the array arguments appear here."""
+        params, heads = self._served
+        if self.mesh is not None:
+            def sds(x):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=x.sharding)
+        else:
+            def sds(x):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        params_a = jax.tree_util.tree_map(sds, params)
+        heads_a = (None if heads is None
+                   else jax.tree_util.tree_map(sds, heads))
+        if family == "batched":
+            host = self._dummy_host(bucket)
+            if self.mesh is not None:
+                shards = shd.batch_shardings(host, self.mesh)
+                batch_a = jax.tree_util.tree_map(
+                    lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                      sharding=s),
+                    host, shards)
+            else:
+                batch_a = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for n, v in host.items()}
+            return (params_a, heads_a, batch_a)
+        # rows: the slab shapes mirror _row_slab, the pack mirrors
+        # _run_rows' explicit device_put.
+        ecfg, mcfg = self.cfg.engine, self.cfg.model
+        n_rows = (1 + ecfg.device_input_cache_entries
+                  + ecfg.max_batch_rows())
+        nv = ecfg.max_regions
+        slab_a = dict(
+            features=jax.ShapeDtypeStruct(
+                (n_rows, nv, mcfg.v_feature_size), self.transfer_dtype),
+            spatials=jax.ShapeDtypeStruct((n_rows, nv, 5), np.float32),
+            image_mask=jax.ShapeDtypeStruct((n_rows, nv), np.int32))
+        text_shape = (bucket, ecfg.max_text_len)
+        pack_a = dict(
+            input_ids=jax.ShapeDtypeStruct(text_shape, np.int32),
+            segment_ids=jax.ShapeDtypeStruct(text_shape, np.int32),
+            input_mask=jax.ShapeDtypeStruct(text_shape, np.int32),
+            task_ids=jax.ShapeDtypeStruct((bucket, 1), np.int32),
+            rows=jax.ShapeDtypeStruct((bucket,), np.int32))
+        return (params_a, heads_a, slab_a, pack_a)
+
+    def book_boot_time(self, phase: str, seconds: float) -> None:
+        """Accumulate one boot-phase duration (restore_s / cache_load_s /
+        compile_s / upload_s). The serving layer stamps restore_s; the
+        engine books the rest. Surfaces in live_stats() → /healthz."""
+        with self._boot_lock:
+            self.boot_times[phase] = (
+                self.boot_times.get(phase, 0.0) + seconds)
+
+    def boot_from_cache(self, buckets: Optional[Sequence[int]] = None
+                        ) -> bool:
+        """Warm-boot path: install every warmup program from the AOT cache
+        WITHOUT compiling anything. True iff every bucket's program
+        deserialized — the pool then skips warmup() entirely (executables
+        are proven by their first live call; a stale one falls back to the
+        jitted forward, see :class:`_AotProgram`). On any miss nothing was
+        compiled here — the caller falls back to warmup(), which compiles
+        the misses and backfills the cache."""
+        if self._aot is None:
+            return False
+        buckets = list(buckets if buckets is not None
+                       else self.cfg.engine.all_row_buckets())
+        builder = self._forward if self.mesh is not None \
+            else self._forward_rows
+        ok = True
+        for b in buckets:
+            fn = builder(b, False)
+            if isinstance(fn, _AotProgram):
+                ok = (fn.ensure(load_only=True) is not None) and ok
+        return ok
+
+    def aot_compile_record(self, family: str, bucket: int, attn: bool
+                           ) -> str:
+        """Prewarm one manifest record: ``"hit"`` if already cached, else
+        lower+compile+serialize → ``"compiled"`` (the engine.prewarm CLI's
+        per-record primitive)."""
+        if self._aot is None:
+            raise RuntimeError("aot_compile_record needs the AOT cache "
+                               "(set EngineConfig.aot_cache_dir)")
+        builder = self._forward if family == "batched" \
+            else self._forward_rows
+        fn = builder(bucket, attn)
+        if not isinstance(fn, _AotProgram):
+            return "compiled"
+        return fn.ensure() or "compiled"
 
     @property
     def pallas_enabled(self) -> bool:
@@ -1050,6 +1306,19 @@ class InferenceEngine:
             }
         with self._compile_lock:
             stats["engine_compiled_programs"] = float(len(self._compiled))
+            progs = [f for f in self._compiled.values()
+                     if isinstance(f, _AotProgram)]
+        if self._aot is not None:
+            stats["engine_aot_hits"] = float(
+                sum(1 for p in progs if p.from_cache))
+            stats["engine_aot_compiled"] = float(
+                sum(1 for p in progs if p.resolved and not p.from_cache
+                    and not p.fell_back))
+            stats["engine_aot_fallbacks"] = float(
+                sum(1 for p in progs if p.fell_back))
+        with self._boot_lock:
+            for phase, secs in self.boot_times.items():
+                stats[f"engine_boot_{phase}"] = float(secs)
         stats["engine_breaker_open"] = float(
             self._breaker.state != "closed")
         return stats

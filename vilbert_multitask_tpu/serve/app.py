@@ -50,6 +50,23 @@ class ServeApp:
             self.cfg = dataclasses.replace(
                 self.cfg, engine=dataclasses.replace(
                     self.cfg.engine, compilation_cache_dir=cache_dir))
+        # AOT executable cache (engine/aotcache.py) on by default too:
+        # NEXT TO THE CHECKPOINT when one is given — the executables are
+        # as much a build artifact of the deployed weights as the weights
+        # themselves, and a prewarm CI step populates them in the same
+        # place every replica host mounts. No checkpoint (random-weights
+        # dev boots) → under serve_state with the other durable files.
+        # An explicit EngineConfig value wins.
+        if self.cfg.engine.aot_cache_dir is None:
+            aot_dir = (
+                os.path.join(os.path.dirname(os.path.abspath(
+                    checkpoint_path)), "aot_cache")
+                if checkpoint_path is not None else
+                os.path.join(os.path.dirname(s.queue_db_path)
+                             or "serve_state", "aot_cache"))
+            self.cfg = dataclasses.replace(
+                self.cfg, engine=dataclasses.replace(
+                    self.cfg.engine, aot_cache_dir=aot_dir))
         self.boot_info: dict = {"phase": "booting"}
         self.extractor = None  # set when live_extract builds a detector
         self.hub = PushHub()
@@ -73,15 +90,37 @@ class ServeApp:
 
                 mesh = build_mesh(self.cfg.mesh)
             params = None
+            restore = None
             if checkpoint_path is not None:
-                from vilbert_multitask_tpu.checkpoint import restore_params
+                from vilbert_multitask_tpu.checkpoint import (
+                    restore_params_async,
+                )
 
                 # Serving restore casts to the engine's param-storage dtype
                 # host-side (bf16 ships half the checkpoint bytes; "int8"
                 # quantizes to per-channel pairs, ~¼ of f32); the on-disk
-                # checkpoint stays the f32 master.
-                params = restore_params(checkpoint_path, mesh=mesh,
-                                        dtype=self.cfg.engine.param_dtype)
+                # checkpoint stays the f32 master. Async: the restore's
+                # disk/PCIe time overlaps the AOT cache prefetch below —
+                # the two longest boot phases run concurrently.
+                restore = restore_params_async(
+                    checkpoint_path, mesh=mesh,
+                    dtype=self.cfg.engine.param_dtype)
+            # ONE AotCache shared by the whole pool: replicas compile the
+            # same programs, so the first to miss populates the entry the
+            # rest deserialize. prefetch() pulls the entry bytes off disk
+            # while the checkpoint restore is still running.
+            aot = None
+            if self.cfg.engine.aot_cache_dir:
+                from vilbert_multitask_tpu.engine import aotcache
+
+                aot = aotcache.AotCache(
+                    self.cfg.engine.aot_cache_dir,
+                    aotcache.compile_fingerprint(
+                        self.cfg, mesh=mesh,
+                        heads=self.cfg.engine.fused_task_heads))
+                self.boot_info["aot_prefetched"] = aot.prefetch()
+            if restore is not None:
+                params = restore.join()
             store = FeatureStore(feature_root)
             if live_extract:
                 # Novel uploads with no precomputed .npy run through the
@@ -122,10 +161,15 @@ class ServeApp:
                 for i in range(max(1, s.pool_replicas)):
                     engines.append(InferenceEngine(
                         self.cfg, params=params, mesh=mesh,
-                        feature_store=store, replica_id=f"r{i}"))
+                        feature_store=store, replica_id=f"r{i}",
+                        aot_cache=aot))
                     if params is None:
                         params = engines[0].params
                 engine = engines
+                if restore is not None:
+                    # Surface the overlapped restore in engine 0's
+                    # boot-phase split alongside cache_load/compile/upload.
+                    engines[0].book_boot_time("restore_s", restore.seconds)
             self.boot_info["engine_init_s"] = round(
                 time.perf_counter() - t0, 1)
         # The serving plane always programs against a ReplicaPool — with
@@ -140,6 +184,7 @@ class ServeApp:
                 else [engine]
             self.engine = ReplicaPool(engines, serving=s)
         self.boot_info["replicas"] = [r.name for r in self.engine.replicas]
+        self._refresh_boot_phases()
         self.worker = ServeWorker(self.engine, self.queue, self.store,
                                   self.hub, s)
         # Live-health plane (obs/): the time-series store + sampler, the
@@ -195,6 +240,21 @@ class ServeApp:
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
         self._worker_thread: Optional[threading.Thread] = None
+
+    def _refresh_boot_phases(self) -> None:
+        """Fold the engines' boot-phase split (restore_s / cache_load_s /
+        compile_s / upload_s, engine/aotcache.py) into ``/healthz``'s boot
+        section. Summed across the pool — warmup phases accumulate, so this
+        runs again after :meth:`warm`. Tolerates injected test doubles."""
+        phases: dict = {}
+        for rep in getattr(self.engine, "replicas", []):
+            times = getattr(rep.engine, "boot_times", None)
+            if not times:
+                continue
+            for phase, seconds in dict(times).items():
+                phases[phase] = round(phases.get(phase, 0.0) + seconds, 3)
+        if phases:
+            self.boot_info["boot_phases"] = phases
 
     # ------------------------------------------------------- live health
     def _build_slos(self) -> "obs.SloEvaluator":
@@ -287,6 +347,7 @@ class ServeApp:
             pallas=self.engine.pallas_enabled,
             kernel_fallback=self.engine.kernel_fallback,
         )
+        self._refresh_boot_phases()
         # Warming before start() returns to "booting" (still not serving);
         # a live re-warm must not flip an already-ready replica out of the
         # load balancer.

@@ -216,17 +216,22 @@ class ReplicaPool:
     def warmup(self, buckets=None, parallel=None) -> None:
         """Warm every replica, walking each through booting→warming→ready.
 
-        Serial by default: with the persistent compilation cache on,
-        replica 1..n-1 hit the cache replica 0 populated, so serial warmup
-        costs ~one compile total, and the pool becomes partially available
-        as soon as the first replica flips ready.
+        Each replica first tries the AOT boot-from-cache path
+        (:meth:`_boot_from_cache`): on a warm cache every program
+        deserializes and warmup is SKIPPED — replicas come up in seconds.
+        Otherwise serial warmup, as before: with the persistent
+        compilation cache on, replica 1..n-1 hit the cache replica 0
+        populated, so serial warmup costs ~one compile total, and the pool
+        becomes partially available as soon as the first replica flips
+        ready.
         """
         for rep in self.replicas:
             if rep.state == STATE_DEAD:
                 continue
             self._set_state(rep, STATE_WARMING)
             try:
-                rep.engine.warmup(buckets=buckets, parallel=parallel)
+                if not self._boot_from_cache(rep, buckets):
+                    rep.engine.warmup(buckets=buckets, parallel=parallel)
             except Exception as e:  # noqa: BLE001 — a bad replica must not
                 rep.last_error = repr(e)  # sink the whole boot.
                 self._set_state(rep, STATE_DEAD)
@@ -234,6 +239,58 @@ class ReplicaPool:
                                  error=repr(e))
                 continue
             self._set_state(rep, STATE_READY)
+
+    def _boot_from_cache(self, rep: Replica, buckets=None) -> bool:
+        """Try the engine's AOT warm-boot path; True means every warmup
+        program deserialized from the executable cache and warmup can be
+        skipped.  Soft: engines without the capability (test doubles,
+        cache off) or any loader failure → False → plain warmup."""
+        boot = getattr(rep.engine, "boot_from_cache", None)
+        if boot is None:
+            return False
+        try:
+            ok = bool(boot(buckets=buckets))
+        except Exception as e:  # noqa: BLE001 — cache trouble must never
+            obs.record_event(       # be worse than a cold boot.
+                "replica_cache_boot_failed", replica=rep.name,
+                error=repr(e))
+            return False
+        if ok:
+            obs.record_event("replica_boot_from_cache", replica=rep.name)
+        return ok
+
+    def add_replica(self, engine, warm: bool = True) -> Replica:
+        """Scale-out: attach one more engine to the live pool (the
+        autoscaler's actuator, ROADMAP item 2).  The new replica boots
+        from the AOT cache when it can — seconds, not minutes — and only
+        flips ready once warm; in-flight traffic on existing replicas is
+        untouched.  With ``warm=False`` the replica goes straight to
+        ready and pays compiles on first dispatch (the ``--no-warmup``
+        contract)."""
+        with self._cond:
+            names = {r.name for r in self.replicas}
+            i = len(self.replicas)
+            while f"r{i}" in names:
+                i += 1
+        rep = self._make_replica(i, engine)
+        with self._cond:
+            self.replicas.append(rep)
+        obs.REPLICA_STATE.set(STATE_CODES[rep.state], replica=rep.name)
+        if not warm:
+            self._set_state(rep, STATE_READY)
+            return rep
+        self._set_state(rep, STATE_WARMING)
+        try:
+            if not self._boot_from_cache(rep, None):
+                rep.engine.warmup()
+        except Exception as e:  # noqa: BLE001 — same containment as warmup()
+            rep.last_error = repr(e)
+            self._set_state(rep, STATE_DEAD)
+            obs.record_event("replica_boot_failed", replica=rep.name,
+                             error=repr(e))
+            return rep
+        self._set_state(rep, STATE_READY)
+        return rep
 
     def mark_ready(self) -> None:
         """No-warmup boot path: flip still-booting replicas straight to
@@ -467,6 +524,18 @@ class ReplicaPool:
                                      error=repr(e))
                     raise
                 rep.swaps += 1
+                # A same-shape load_params keeps every compiled program;
+                # but if the swap handed this replica a cold engine (no
+                # compiled programs — e.g. a config-bumped rebuild), pull
+                # its executables from the AOT cache before flipping ready
+                # so the first post-swap dispatch doesn't pay a compile.
+                try:
+                    cold = not rep.engine.live_stats().get(
+                        "engine_compiled_programs", 0.0)
+                except Exception:  # noqa: BLE001 — doubles without
+                    cold = False   # live_stats() can't be cold-detected.
+                if cold:
+                    self._boot_from_cache(rep, None)
                 self._set_state(rep, STATE_READY)
                 note_ready()
                 obs.record_event("replica_swap", replica=rep.name,

@@ -12,6 +12,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 fail=0
+lint_t0=$(python -c 'import time; print(time.perf_counter())')
 
 echo "== vmtlint (strict, changed-closure scan; VMT_FULL=1 for whole repo) =="
 # --strict: warnings gate too, and stale baseline entries fail — debt
@@ -46,6 +47,52 @@ echo "== durable-state surface (TXN_SURFACE.json vs the tree) =="
 # regenerating the contract ROADMAP item 3's multi-process work reads —
 # rerun `python -m vilbert_multitask_tpu.analysis txn` and commit.
 python -m vilbert_multitask_tpu.analysis txn --check || fail=1
+
+echo "== protocol surface (PROTOCOL_SURFACE.json vs the tree) =="
+# The committed manifest enumerates the typestate protocols (job
+# claim→terminal, replica checkout→checkin, thread start→join, sqlite
+# connect→close): acquire sites, composed wrappers with witnesses, the
+# per-function path-proof verdicts, and fault-site chaos coverage.
+# Drift means a protocol path changed without regenerating the proof —
+# rerun `python -m vilbert_multitask_tpu.analysis proto` and commit.
+python -m vilbert_multitask_tpu.analysis proto --check || fail=1
+
+echo "== exactly-one-terminal invariant (VMT132 clean scan) =="
+# The load-bearing serving invariant, proved statically over every CFG
+# path: any unbaselined VMT132 finding anywhere in the library tree
+# fails the run outright, independent of severity config.
+python - <<'PY' || fail=1
+import os, sys
+from vilbert_multitask_tpu.analysis import baseline as bl
+from vilbert_multitask_tpu.analysis.config import load_config
+from vilbert_multitask_tpu.analysis.core import analyze_paths
+from vilbert_multitask_tpu.analysis.protorules import JobTerminalProtocol
+
+cfg, root = load_config(os.getcwd())
+root = root or os.getcwd()
+paths = [os.path.join(root, p) for p in cfg.paths]
+findings = analyze_paths([p for p in paths if os.path.exists(p)],
+                         root=root, rules=[JobTerminalProtocol()],
+                         exclude=cfg.exclude,
+                         library_roots=cfg.library_roots,
+                         layers=cfg.layers)
+baseline = {}
+bl_path = os.path.join(root, cfg.baseline) if cfg.baseline else None
+if bl_path and os.path.exists(bl_path):
+    baseline = bl.load_baseline(bl_path)
+new, _, _ = bl.split_baselined(findings, baseline)
+for f in new:
+    print(f"VMT132 invariant: {f.path}:{f.line}: {f.message}",
+          file=sys.stderr)
+sys.exit(1 if new else 0)
+PY
+
+# Analyzer wall time for the whole static block above (strict scan +
+# baseline hygiene + three surface gates): the tier count keeps growing,
+# so full-scan latency regressions gate like bench regressions.
+python scripts/perf_ledger.py append lint \
+  "wall_s=$(python -c "import time; print(f'{time.perf_counter() - $lint_t0:.3f}')")" \
+  || true
 
 if [[ "${1:-}" == "--lint" ]]; then
   exit "$fail"

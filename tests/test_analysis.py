@@ -372,6 +372,34 @@ def test_narrow_except_and_del_teardown_are_clean():
     assert "VMT107" not in rules_hit(src)
 
 
+def test_pass_with_working_continuation_is_clean():
+    # CFG-aware half of the rule: `pass` is an acceptable degrade when
+    # the code after the handler still does real work on that path.
+    src = """
+    def snapshot(self):
+        snap = {"ok": True}
+        try:
+            snap["stats"] = self._stats()
+        except Exception:
+            pass
+        self._json(200, snap)
+    """
+    assert "VMT107" not in rules_hit(src)
+
+
+def test_pass_at_function_end_still_fires():
+    # No continuation does any work after the swallow -> still a
+    # swallowed exception, CFG or not.
+    src = """
+    def fire_and_forget(self, evt):
+        try:
+            self._emit(evt)
+        except Exception:
+            pass
+    """
+    assert "VMT107" in rules_hit(src)
+
+
 # ----------------------------------------------------------------- VMT108
 def test_module_numpy_mutation_triggers():
     src = """

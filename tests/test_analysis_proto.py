@@ -1,0 +1,536 @@
+"""Protocol tier suite: typestate fixture pairs for VMT132-135, the
+real-tree pins (worker/scheduler claim paths verify clean; chaos
+coverage is total), and the protocol manifest (PROTOCOL_SURFACE.json) —
+determinism, drift detection, and the byte-for-byte committed gate CI
+runs via ``proto --check``.
+
+Rule fixtures are multi-module dicts through ``analyze_project``: the
+registry resolves protocol verbs against the classes that declare them,
+and wrapper composition crosses files exactly like the real
+worker/scheduler split does.
+"""
+
+import ast
+import copy
+import json
+import os
+import textwrap
+
+import pytest
+
+from vilbert_multitask_tpu.analysis import analyze_project
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.graph import ProjectGraph
+from vilbert_multitask_tpu.analysis import proto as proto_mod
+from vilbert_multitask_tpu.analysis.proto import (
+    build_proto_surface,
+    diff_proto_surface,
+    proto_flow,
+    render_proto_surface,
+    render_proto_surface_sarif,
+)
+from vilbert_multitask_tpu.analysis.protorules import FaultPointCoverage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, proto_mod.MANIFEST_NAME)
+
+
+def project(sources):
+    ctxs = []
+    for path in sorted(sources):
+        src = textwrap.dedent(sources[path])
+        ctxs.append(ModuleContext(path, src, ast.parse(src)))
+    graph = ProjectGraph(ctxs)
+    for c in ctxs:
+        c.project = graph
+    return graph
+
+
+def findings(sources):
+    return analyze_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        library_roots=("pkg", "vilbert_multitask_tpu"))
+
+
+def rules_hit(sources):
+    return {f.rule for f in findings(sources)}
+
+
+def _tree_sources():
+    """The exact source set the proto CLI loads: configured paths minus
+    excludes — library tree plus tests/ and scripts/ (the fault-coverage
+    map needs to see the FaultPlans that live in tests)."""
+    from vilbert_multitask_tpu.analysis.config import load_config
+    from vilbert_multitask_tpu.analysis.core import iter_python_files
+
+    cfg, root = load_config(REPO)
+    root = root or REPO
+    roots = [os.path.join(root, p) for p in cfg.paths]
+    out = {}
+    for path in iter_python_files(
+            [r for r in roots if os.path.exists(r)], exclude=cfg.exclude):
+        rel = os.path.relpath(os.path.abspath(path),
+                              root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            out[rel] = f.read()
+    return out
+
+
+@pytest.fixture(scope="module")
+def repo_flow():
+    return proto_flow(project(_tree_sources()))
+
+
+@pytest.fixture(scope="module")
+def fresh_surface(repo_flow):
+    return build_proto_surface(repo_flow.project)
+
+
+# The queue a worker claims from: providers for the job protocol.
+_QUEUE = """
+class Queue:
+    def claim(self):
+        return self._pop()
+
+    def ack(self, job_id):
+        self._settle(job_id, "done")
+
+    def nack(self, job_id):
+        self._settle(job_id, "retry")
+
+    def release(self, job_id):
+        self._settle(job_id, "requeue")
+"""
+
+# The pool a dispatcher checks replicas out of.
+_POOL = """
+class Pool:
+    def checkout(self):
+        return self._pick()
+
+    def checkin(self, rep):
+        self._ready.append(rep)
+"""
+
+
+# ----------------------------------------------------------------- VMT132
+def test_vmt132_leaked_claim_on_untaken_branch():
+    srcs = {"pkg/q.py": _QUEUE, "pkg/w.py": """
+    class Worker:
+        def bad(self):
+            job = self.q.claim()
+            if job is None:
+                return
+            if job.retryable:
+                self.q.ack(job.id)
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT132"]
+    assert len(fs) == 1 and "leaked claim" in fs[0].message
+    # The witness chain names the claim and the leaking exit.
+    assert fs[0].flows and len(fs[0].flows[0]) >= 2
+
+
+def test_vmt132_every_path_terminates_is_clean():
+    srcs = {"pkg/q.py": _QUEUE, "pkg/w.py": """
+    class Worker:
+        def good(self):
+            job = self.q.claim()
+            if job is None:
+                return
+            try:
+                self.handle(job.body)
+            except Exception:
+                self.q.nack(job.id)
+                return
+            self.q.ack(job.id)
+    """}
+    assert "VMT132" not in rules_hit(srcs)
+
+
+def test_vmt132_double_terminal_fires_with_both_witnesses():
+    srcs = {"pkg/q.py": _QUEUE, "pkg/w.py": """
+    class Worker:
+        def twice(self):
+            job = self.q.claim()
+            if job is None:
+                return
+            self.q.ack(job.id)
+            self.q.release(job.id)
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT132"]
+    assert len(fs) == 1 and "double terminal" in fs[0].message
+    # codeFlow: claim -> first terminal -> second terminal.
+    assert len(fs[0].flows[0]) == 3
+
+
+def test_vmt132_terminal_then_handler_terminal_is_not_double():
+    # The terminal itself may raise mid-flight (the exception edge fires
+    # from its own boundary), so a compensating terminal in the handler
+    # is the CORRECT shape, not a double.
+    srcs = {"pkg/q.py": _QUEUE, "pkg/w.py": """
+    class Worker:
+        def safe(self):
+            job = self.q.claim()
+            if job is None:
+                return
+            try:
+                self.q.ack(job.id)
+            except Exception:
+                self.q.nack(job.id)
+    """}
+    assert "VMT132" not in rules_hit(srcs)
+
+
+def test_vmt132_composes_through_wrappers_across_files():
+    # claim behind a helper, terminal behind another: the per-function
+    # summaries compose through the call graph, so the leak in `run`
+    # is visible even though `run` itself names no protocol verb.
+    srcs = {"pkg/q.py": _QUEUE, "pkg/claimer.py": """
+    class Claimer:
+        def pull(self):
+            job = self.q.claim()
+            return job
+    """, "pkg/w.py": """
+    class Worker:
+        def _fail(self, job):
+            self.q.nack(job.id)
+
+        def run(self):
+            job = self.claimer.pull()
+            if job is None:
+                return
+            if job.retryable:
+                self._fail(job)
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT132"]
+    assert [f.path for f in fs] == ["pkg/w.py"]
+    fixed = copy.deepcopy(srcs)
+    fixed["pkg/w.py"] = srcs["pkg/w.py"].replace(
+        "if job.retryable:\n                self._fail(job)",
+        "self._fail(job)")
+    assert fixed["pkg/w.py"] != srcs["pkg/w.py"]
+    assert "VMT132" not in rules_hit(fixed)
+
+
+def test_vmt132_escaped_handle_is_the_callees_obligation():
+    # Returning or storing the claimed handle hands the terminal
+    # obligation off — the path walk must not call that a leak.
+    srcs = {"pkg/q.py": _QUEUE, "pkg/w.py": """
+    class Worker:
+        def stash(self):
+            job = self.q.claim()
+            if job is None:
+                return None
+            self._inflight[job.id] = job
+            return job
+    """}
+    assert "VMT132" not in rules_hit(srcs)
+
+
+def test_vmt132_is_library_only():
+    srcs = {"pkg/q.py": _QUEUE, "tests/test_w.py": """
+    def test_claim_and_drop(q):
+        job = q.claim()
+        assert job.body
+    """}
+    assert "VMT132" not in rules_hit(srcs)
+
+
+# ----------------------------------------------------------------- VMT133
+def test_vmt133_checkout_abandoned_on_raise():
+    srcs = {"pkg/pool.py": _POOL, "pkg/d.py": """
+    class Dispatcher:
+        def bad(self):
+            rep = self.pool.checkout()
+            if self.draining:
+                raise RuntimeError("drain")
+            self.pool.checkin(rep)
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT133"]
+    assert len(fs) == 1 and "rep" in fs[0].message
+    assert fs[0].flows  # acquire -> raise witness chain
+
+
+def test_vmt133_checkin_before_raise_is_clean():
+    srcs = {"pkg/pool.py": _POOL, "pkg/d.py": """
+    class Dispatcher:
+        def good(self):
+            rep = self.pool.checkout()
+            try:
+                out = rep.run()
+            except Exception as e:
+                self.pool.checkin(rep)
+                raise RuntimeError("failover") from e
+            self.pool.checkin(rep)
+            return out
+    """}
+    assert "VMT133" not in rules_hit(srcs)
+
+
+def test_vmt133_started_thread_abandoned_on_raise():
+    srcs = {"pkg/t.py": """
+    import threading
+
+    def bad(self):
+        t = threading.Thread(target=self._drain)
+        t.start()
+        if self.misconfigured:
+            raise ValueError("bad config")
+        t.join()
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT133"]
+    assert len(fs) == 1 and "thread" in fs[0].message
+
+
+def test_vmt133_raise_before_start_is_clean():
+    srcs = {"pkg/t.py": """
+    import threading
+
+    def good(self):
+        t = threading.Thread(target=self._drain)
+        if self.misconfigured:
+            raise ValueError("bad config")
+        t.start()
+        t.join()
+    """}
+    assert "VMT133" not in rules_hit(srcs)
+
+
+def test_vmt133_bare_sqlite_connection_abandoned_on_raise():
+    srcs = {"pkg/s.py": """
+    import sqlite3
+
+    def bad(path, expected):
+        conn = sqlite3.connect(path)
+        row = conn.execute("SELECT v FROM kv").fetchone()
+        if row[0] != expected:
+            raise ValueError("drifted")
+        conn.close()
+        return row
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT133"]
+    assert len(fs) == 1 and "sqlite" in fs[0].message
+
+
+def test_vmt133_with_managed_connection_is_clean():
+    # `with` releases through __exit__ on every edge — never tracked.
+    srcs = {"pkg/s.py": """
+    import sqlite3
+
+    def good(path, expected):
+        with sqlite3.connect(path) as conn:
+            row = conn.execute("SELECT v FROM kv").fetchone()
+            if row[0] != expected:
+                raise ValueError("drifted")
+            return row
+    """}
+    assert "VMT133" not in rules_hit(srcs)
+
+
+# ----------------------------------------------------------------- VMT134
+_FAULTED = {"pkg/svc.py": """
+def send(payload):
+    payload = fault_point("svc.send", payload)
+    return _post(payload)
+"""}
+
+
+def test_vmt134_uncovered_fault_site_fires():
+    fs = [f for f in findings(_FAULTED) if f.rule == "VMT134"]
+    assert len(fs) == 1 and "svc.send" in fs[0].message
+
+
+def test_vmt134_covered_by_exact_rule_is_clean():
+    srcs = dict(_FAULTED)
+    srcs["tests/test_chaos.py"] = """
+    def test_send_chaos(plan):
+        install_plan(FaultPlan(1, [FaultRule("svc.send", "error")]))
+    """
+    assert "VMT134" not in rules_hit(srcs)
+
+
+def test_vmt134_covered_by_prefix_rule_is_clean():
+    srcs = dict(_FAULTED)
+    srcs["scripts/chaos.py"] = """
+    RULES = [FaultRule("svc.*", "error", rate=0.5)]
+    """
+    assert "VMT134" not in rules_hit(srcs)
+
+
+def test_vmt134_suppressed_on_partial_scan():
+    # A --changed subset cannot prove a site is covered NOWHERE.
+    rule = FaultPointCoverage()
+    rule.partial_scan = True
+    graph = project(_FAULTED)
+    ctx = graph.modules["pkg.svc"].ctx
+    assert list(rule.check(ctx)) == []
+
+
+# ----------------------------------------------------------------- VMT135
+_STORE = """
+import sqlite3
+
+class Store:
+    def boot(self):
+        with sqlite3.connect(self.path) as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS jobs ("
+                "id INTEGER PRIMARY KEY, "
+                "status TEXT NOT NULL DEFAULT 'pending')")
+
+    def claim(self, now):
+        with sqlite3.connect(self.path) as c:
+            c.execute("UPDATE jobs SET status='inflight' WHERE id=?",
+                      (now,))
+
+    def bury(self, job_id):
+        with sqlite3.connect(self.path) as c:
+            c.execute("UPDATE jobs SET status='dead' WHERE id=?",
+                      (job_id,))
+"""
+
+
+def test_vmt135_drifted_status_literal_with_did_you_mean():
+    srcs = {"pkg/store.py": _STORE, "pkg/push.py": """
+    def frame(job):
+        return {"status": "inflite", "id": job.id}
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT135"]
+    assert len(fs) == 1
+    assert "inflite" in fs[0].message and "'inflight'" in fs[0].message
+
+
+def test_vmt135_machine_states_are_clean():
+    srcs = {"pkg/store.py": _STORE, "pkg/push.py": """
+    def frame(job):
+        if job.status == "dead":
+            return {"status": "dead"}
+        return {"status": "pending"}
+    """}
+    assert "VMT135" not in rules_hit(srcs)
+
+
+def test_vmt135_silent_without_a_recovered_machine():
+    # No jobs.status machine in the project -> nothing to drift from.
+    srcs = {"pkg/push.py": """
+    def frame(job):
+        return {"status": "whatever"}
+    """}
+    assert "VMT135" not in rules_hit(srcs)
+
+
+# ------------------------------------------------------ the real tree
+def test_repo_claim_paths_verify_clean(repo_flow):
+    # The load-bearing pin: the worker and scheduler claim paths prove
+    # exactly-one-terminal over every CFG path. The single accepted
+    # VMT132 finding is the /worker/claim remote handoff (baselined with
+    # its contract citation in vmtlint_baseline.json).
+    assert [e["path"] for e in repo_flow.job_findings] == [
+        "vilbert_multitask_tpu/serve/http_api.py"]
+    assert repo_flow.leak_findings == []
+    assert repo_flow.frame_findings == []
+
+
+def test_repo_chaos_coverage_is_total(repo_flow):
+    # Every fault_point in the library tree is named by some FaultRule
+    # in tests/ or scripts/ — VMT134's whole point.
+    assert repo_flow.fault_findings == []
+    assert {fp["site"] for fp in repo_flow.fault_points} >= {
+        "worker.intake", "queue.claim", "queue.publish",
+        "push.publish", "remote.post", "engine.dispatch"}
+    assert all(fp["covered_by"] for fp in repo_flow.fault_points)
+
+
+def test_repo_worker_terminal_wrappers_compose(repo_flow):
+    wrappers = {q.split(":", 1)[1]: info
+                for q, info in repo_flow.summaries.items()}
+    # _claim returns a fresh job handle...
+    assert wrappers["ServeWorker._claim"].acquire_return[0] == "job"
+    # ...and the failure paths are composed terminals for it.
+    for fn in ("ServeWorker._fail_job", "ServeWorker._failover_job",
+               "ServeWorker._expire_job"):
+        assert wrappers[fn].terminal_params["job"][0] == "job"
+
+
+def test_repo_step_batch_proof_is_exactly_one(fresh_surface):
+    verdicts = {p["function"]: p["verdict"]
+                for p in fresh_surface["proof"]}
+    assert verdicts[
+        "vilbert_multitask_tpu.serve.worker.ServeWorker.step_batch"] \
+        == "exactly-one"
+    assert verdicts[
+        "vilbert_multitask_tpu.serve.worker.ServeWorker._claim"] \
+        == "exactly-one"
+
+
+def test_surface_covers_the_three_protocols(fresh_surface):
+    protos = fresh_surface["protocols"]
+    assert {"job", "replica", "thread", "sqlite"} <= set(protos)
+    # job: declared by both the durable queue and its remote twin.
+    decl = {d["method"] for d in protos["job"]["declared_by"]}
+    assert {"DurableQueue.claim", "RemoteQueue.claim"} <= decl
+    assert any(s["path"] == "vilbert_multitask_tpu/serve/pool.py"
+               for s in protos["replica"]["acquire_sites"])
+    assert protos["thread"]["acquire_sites"]
+
+
+# ---------------------------------------------------------------- manifest
+def test_surface_is_deterministic():
+    a = render_proto_surface(build_proto_surface(project(_tree_sources())))
+    b = render_proto_surface(build_proto_surface(project(_tree_sources())))
+    assert a == b
+
+
+def test_committed_manifest_matches_tree_byte_for_byte(fresh_surface):
+    with open(MANIFEST, "r", encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == render_proto_surface(fresh_surface), (
+        "PROTOCOL_SURFACE.json drifted — regenerate with `python -m "
+        "vilbert_multitask_tpu.analysis proto` and commit")
+
+
+def test_diff_reports_protocol_and_proof_drift(fresh_surface):
+    msgs = diff_proto_surface(None, fresh_surface)
+    assert msgs and "missing" in msgs[0]
+    mutated = copy.deepcopy(fresh_surface)
+    del mutated["protocols"]["job"]
+    assert any("`job`" in m for m in
+               diff_proto_surface(mutated, fresh_surface))
+    mutated = copy.deepcopy(fresh_surface)
+    mutated["protocols"]["replica"]["acquire_sites"].pop()
+    assert any("acquire site" in m for m in
+               diff_proto_surface(mutated, fresh_surface))
+    mutated = copy.deepcopy(fresh_surface)
+    mutated["proof"][0]["verdict"] = "violations-everywhere"
+    assert any("verdict" in m for m in
+               diff_proto_surface(mutated, fresh_surface))
+    # Metadata-only drift (a witness line moved) still reports.
+    mutated = copy.deepcopy(fresh_surface)
+    mutated["counts"]["wrappers"] += 1
+    assert diff_proto_surface(mutated, fresh_surface)
+    assert diff_proto_surface(fresh_surface, fresh_surface) == []
+
+
+def test_sarif_rendering_carries_witness_flows(fresh_surface):
+    doc = json.loads(render_proto_surface_sarif(fresh_surface))
+    assert doc["version"] == "2.1.0" and "$schema" in doc
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "vmtlint-proto"
+    results = run["results"]
+    assert len(results) == (fresh_surface["counts"]["acquire_sites"]
+                            + fresh_surface["counts"]["fault_points"])
+    for r in results:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+    assert any(r.get("codeFlows") for r in results)
+    for r in results:
+        for flow in r.get("codeFlows", []):
+            assert flow["threadFlows"][0]["locations"]
+
+
+def test_proto_check_gate_is_clean(monkeypatch):
+    from vilbert_multitask_tpu.analysis.cli import main as cli_main
+
+    monkeypatch.chdir(REPO)
+    assert cli_main(["proto", "--check"]) == 0

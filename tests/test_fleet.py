@@ -283,6 +283,10 @@ def test_ledger_append_read_and_direction(tmp_path):
     assert key_direction("p95_ms") == "lower"
     assert key_direction("batch_qps") == "higher"
     assert key_direction("knee_rows") == "higher"
+    # "_per_s" ends with "_s" too: rates must gate as throughput, not
+    # latency (a faster txn.stress run is not a regression).
+    assert key_direction("claims_per_s") == "higher"
+    assert key_direction("wall_s") == "lower"
     assert key_direction("git_rev") is None  # meta, never gated
 
 

@@ -399,6 +399,30 @@ def test_intake_fault_injection_dead_letters(stack):
     assert len(dead) == 1 and "injected fault" in dead[0]["error"]
 
 
+def test_publish_fault_injection_raises_before_enqueue(stack):
+    # queue.publish sits BEFORE the INSERT: an injected transport error
+    # must surface to the caller with nothing durably enqueued (the
+    # client retries; at-least-once starts only after the row exists).
+    s, hub, q, store, worker = stack
+    install_plan(FaultPlan(1, [FaultRule("queue.publish", "error")]))
+    with pytest.raises(FaultInjected):
+        q.publish(make_job_message(["img_a.jpg"], "never lands", 1, "sockQ"))
+    assert q.counts() == {}  # no half-published row
+
+
+def test_push_fault_injection_is_best_effort(stack):
+    # push.publish is best-effort by contract: an injected fault on the
+    # frame hub drops that frame (returns 0 fanout) instead of raising
+    # into the worker's terminal path.
+    s, hub, q, store, worker = stack
+    sub = hub.subscribe("sockP")
+    install_plan(FaultPlan(1, [FaultRule("push.publish", "error")]))
+    assert hub.publish("sockP", {"answer": "lost"}) == 0
+    assert _drain(sub) == []  # subscriber saw nothing
+    clear_plan()
+    assert hub.publish("sockP", {"answer": "ok"}) == 1  # plane recovers
+
+
 # --------------------------------------------------------- graceful drain
 def test_drain_stops_claiming_when_stop_set(stack):
     import threading

@@ -178,6 +178,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _surface_main(argv[1:])
     if argv and argv[0] == "txn":
         return _txn_main(argv[1:])
+    if argv and argv[0] == "proto":
+        return _proto_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for cls in RULES:
@@ -451,6 +453,95 @@ def _txn_main(argv: Sequence[str]) -> int:
     print(f"vmtlint txn: wrote {fresh['counts']['tables']} table(s), "
           f"{fresh['counts']['txn_sites']} transaction site(s) to "
           f"{out_path}", file=sys.stderr)
+    return 0
+
+
+def _proto_main(argv: Sequence[str]) -> int:
+    """``vmtlint proto [--check] [--out FILE] [--format json|sarif]``:
+    build the protocol-surface manifest (typestate protocols, acquire
+    sites, composed wrappers with witness chains, per-function path
+    proofs, fault-site coverage) and write, print, or verify it — the
+    PROTOCOL_SURFACE.json sibling of ``surface`` and ``txn``.
+
+    Unlike those two this loads the *configured* paths (tests/ and
+    scripts/ included, not just library roots): the fault-coverage map
+    needs to see the FaultPlans that live in tests, even though findings
+    and protocol declarations still bind only library code."""
+    from vilbert_multitask_tpu.analysis import proto as proto_mod
+    from vilbert_multitask_tpu.analysis import surface as surf_mod
+
+    p = argparse.ArgumentParser(
+        prog="python -m vilbert_multitask_tpu.analysis proto",
+        description="Enumerate the typestate protocol surface (job "
+                    "claim→terminal, replica checkout→checkin, thread "
+                    "start→join, sqlite connect→close) with per-path "
+                    "proof verdicts and fault-site coverage, as "
+                    "PROTOCOL_SURFACE.json")
+    p.add_argument("--check", action="store_true",
+                   help="verify the committed manifest matches the tree; "
+                        "exit 1 on drift (the CI gate)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help=f"manifest path (default: <repo>/"
+                        f"{proto_mod.MANIFEST_NAME})")
+    p.add_argument("--format", default="json", dest="fmt",
+                   choices=("json", "sarif"),
+                   help="with no --check: 'json' writes the manifest, "
+                        "'sarif' prints protocol witnesses to stdout")
+    args = p.parse_args(argv)
+
+    cfg, root = load_config(os.getcwd())
+    root = root or os.getcwd()
+    roots = [os.path.join(root, r) for r in cfg.paths]
+    roots = [r for r in roots if os.path.exists(r)] or [root]
+    sources = {}
+    for path in iter_python_files(roots, exclude=cfg.exclude):
+        rel = os.path.relpath(os.path.abspath(path),
+                              root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    project = surf_mod.load_project(sources)
+    fresh = proto_mod.build_proto_surface(project)
+    out_path = args.out or os.path.join(root, proto_mod.MANIFEST_NAME)
+
+    if args.check:
+        committed = None
+        if os.path.exists(out_path):
+            try:
+                with open(out_path, "r", encoding="utf-8") as f:
+                    committed = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"vmtlint proto: unreadable manifest "
+                      f"{out_path}: {e}", file=sys.stderr)
+                return 2
+        msgs = proto_mod.diff_proto_surface(committed, fresh)
+        if msgs:
+            for m in msgs:
+                print(f"vmtlint proto: {m}", file=sys.stderr)
+            print("vmtlint proto: protocol surface drifted — "
+                  "regenerate with `python -m vilbert_multitask_tpu."
+                  "analysis proto` and commit the result",
+                  file=sys.stderr)
+            return 1
+        print(f"vmtlint proto: check clean — "
+              f"{fresh['counts']['protocols']} protocol(s), "
+              f"{fresh['counts']['acquire_sites']} acquire site(s), "
+              f"{fresh['counts']['functions_proved']} function(s) "
+              f"proved, {fresh['counts']['fault_points']} fault "
+              f"point(s)", file=sys.stderr)
+        return 0
+
+    if args.fmt == "sarif":
+        sys.stdout.write(proto_mod.render_proto_surface_sarif(fresh))
+        return 0
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(proto_mod.render_proto_surface(fresh))
+    print(f"vmtlint proto: wrote {fresh['counts']['protocols']} "
+          f"protocol(s), {fresh['counts']['acquire_sites']} acquire "
+          f"site(s), {fresh['counts']['fault_points']} fault point(s) "
+          f"to {out_path}", file=sys.stderr)
     return 0
 
 

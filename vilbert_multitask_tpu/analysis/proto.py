@@ -1,0 +1,1288 @@
+"""Typestate protocol analysis: statically prove exactly-one-terminal.
+
+The sixth analyzer tier.  Where the lock tier proves ordering and the txn
+tier proves atomicity, this tier proves *lifecycles*: every acquired
+protocol handle reaches the right number of release events on every
+control-flow path — including the exception edges and early-return
+unwinds the CFG already models.
+
+A :class:`ProtocolRegistry` declares each protocol as a tiny state
+machine over acquire/release verbs, resolved against the classes that
+actually declare them in library code (so a test fixture's ``claim``
+never widens the real protocol, while fixture projects rooted elsewhere
+still register their own providers):
+
+* ``job`` — ``claim -> {ack | nack | release}`` (``serve/queue.py`` and
+  its remote twin, composed through ``serve/worker.py`` and
+  ``serve/scheduler.py``): the system's load-bearing invariant is that a
+  claimed job reaches **exactly one** terminal.
+* ``replica`` — ``checkout -> checkin`` (``serve/pool.py``).
+* ``thread`` — ``threading.Thread(...).start() -> join()``.
+* ``sqlite`` — ``sqlite3.connect() -> close()`` (``with``-managed
+  connections release through ``__exit__`` and are never tracked).
+
+Two engines consume the registry:
+
+* a bounded all-paths walk (:meth:`ProtoFlow._verify_job_function`) that
+  enumerates acyclic CFG paths from each ``claim`` and counts terminals
+  per path, with ``is None`` claim-miss guards refined per branch edge
+  and escape analysis (returned / stored / passed-on handles become the
+  callee's obligation) — the proof behind **VMT132**; and
+* the worklist solver of ``analysis.dataflow`` running a must-held
+  domain (join = intersection) whose facts are the handles definitely
+  live before each event — a ``raise`` reached with a non-empty fact is
+  an exception edge escaping a scope that still owns a handle, the
+  flow-sensitive upgrade of VMT117 behind **VMT133**.
+
+Per-function summaries compose through the call graph to a fixed point,
+the ``LockFlow`` pattern: ``worker._fail_job`` *is* a job terminal
+because every path through it reaches ``queue.nack``, and
+``worker._claim`` *is* an acquire because it returns a freshly claimed
+handle — callers see the composed verbs with full witness chains.
+
+Two project-level cross-checks ride on the same flow: every
+``fault_point("site")`` in library code must be named by a
+``FaultRule`` somewhere in tests/ or scripts/ (**VMT134**), and every
+job-status string literal must be a state of the ``jobs.status`` machine
+the txn tier recovered (**VMT135**, with did-you-mean).
+
+Run generatively (``python -m vilbert_multitask_tpu.analysis proto``)
+the tier emits ``PROTOCOL_SURFACE.json``: every protocol with its
+states, declaration and acquire sites, composed wrappers with witness
+chains, per-function path-proof verdicts, and the fault-site coverage
+map — committed and drift-gated (``proto --check`` in check.sh).
+
+Everything here is stdlib-only (the analysis-layer contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import json
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .cfg import CFG, Block, build_cfg, iter_event_nodes
+from .dataflow import ForwardAnalysis, iter_event_facts, solve
+from .txn import txn_flow
+
+PROTO_VERSION = 1
+MANIFEST_NAME = "PROTOCOL_SURFACE.json"
+
+# Paths that never provide protocol declarations and never host findings:
+# test idioms claim-and-drop on purpose.
+_NON_LIBRARY_HEADS = ("tests", "scripts")
+
+# Per-function path-walk budget.  Functions here are modest (the worst
+# real offender, step_batch, stays well under); a blowup degrades to
+# silence, never to wrong findings.
+_MAX_PATHS = 600
+
+PROTOCOLS: Dict[str, dict] = {
+    "job": {
+        "description": "a claimed job reaches exactly one terminal "
+                       "(ack / nack / release)",
+        "acquire": ("claim",),
+        "terminal": ("ack", "nack", "release"),
+        "states": ["unclaimed", "claimed", "terminal"],
+    },
+    "replica": {
+        "description": "a checked-out replica is always checked back in",
+        "acquire": ("checkout",),
+        "terminal": ("checkin",),
+        "states": ["ready", "checked_out"],
+    },
+    "thread": {
+        "description": "a started thread is joined before its handle "
+                       "is abandoned on an exception path",
+        "acquire": ("start",),
+        "terminal": ("join",),
+        "states": ["created", "started", "joined"],
+    },
+    "sqlite": {
+        "description": "a plain (non-with) sqlite3 connection is closed "
+                       "before an exception path abandons it",
+        "acquire": ("connect",),
+        "terminal": ("close",),
+        "states": ["open", "closed"],
+    },
+}
+
+# Verb -> protocol, for call-site classification.  ``start``/``join``/
+# ``connect``/``close`` are deliberately absent: those verbs are too
+# generic for name-based matching and resolve through value tracking
+# (thread ctor assignments, ``sqlite3.connect``) instead.
+_ACQUIRE_VERBS = {"claim": "job", "checkout": "replica"}
+_TERMINAL_VERBS = {"ack": "job", "nack": "job", "release": "job",
+                   "checkin": "replica"}
+
+_THREAD_CTORS = ("threading.Thread", "threading.Timer")
+
+
+def _is_library(rel_path: str) -> bool:
+    head = rel_path.split("/", 1)[0]
+    if head in _NON_LIBRARY_HEADS:
+        return False
+    base = rel_path.rsplit("/", 1)[-1]
+    return not (base.startswith("test_") or base == "conftest.py")
+
+
+def _witness(path: str, line: int, note: str) -> dict:
+    return {"path": path, "line": line, "message": note}
+
+
+class _Anchor:
+    __slots__ = ("lineno", "col_offset")
+
+    def __init__(self, line: int, col: int = 0) -> None:
+        self.lineno = line
+        self.col_offset = col
+
+
+# ---------------------------------------------------------------------------
+# Per-function facts
+# ---------------------------------------------------------------------------
+
+class _FnProto:
+    """What one function does to protocol handles, composed to a fixed
+    point: ``terminal_params`` maps a parameter name to the witness chain
+    proving some path terminates that handle; ``acquire_return`` is set
+    when the function's return value is a freshly acquired handle."""
+
+    __slots__ = ("fn", "acquire_calls", "terminal_params", "acquire_return")
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+        # [(protocol, verb, line, col)] — direct acquire call sites.
+        self.acquire_calls: List[Tuple[str, str, int, int]] = []
+        # param name -> (protocol, [witness steps])
+        self.terminal_params: Dict[str, Tuple[str, List[dict]]] = {}
+        # (protocol, [witness steps]) when returning a fresh handle.
+        self.acquire_return: Optional[Tuple[str, List[dict]]] = None
+
+
+class _MustHeld(ForwardAnalysis):
+    """Handles definitely live before each event (must: join = ∩).
+
+    ``classify`` maps an event to its protocol ops; the domain only
+    tracks replica/thread/sqlite handles — job claims can legitimately
+    outlive a raise (the visibility sweep redelivers), and their
+    exactly-one-terminal proof is the path walk's job."""
+
+    def __init__(self, classify) -> None:
+        self._classify = classify
+
+    def initial(self) -> FrozenSet[str]:
+        return frozenset()
+
+    def join(self, a: FrozenSet[str], b: FrozenSet[str]) -> FrozenSet[str]:
+        return a & b
+
+    def transfer(self, event, fact: FrozenSet[str]) -> FrozenSet[str]:
+        held = set(fact)
+        for op in self._classify(event):
+            kind = op[0]
+            # Layouts differ: acquire carries its token at op[2],
+            # terminal/escape at op[1] (see _classifier's docstring).
+            if kind == "acquire" and op[1] != "job" and op[2] is not None:
+                held.add(op[2])
+            elif kind in ("terminal", "escape", "kill"):
+                held.discard(op[1])
+        return frozenset(held)
+
+
+class ProtocolRegistry:
+    """Protocol declarations resolved against the project.
+
+    ``providers[verb]`` lists the library classes that declare the verb
+    (``DurableQueue.claim``, ``RemoteQueueClient.claim``, ...).  A call
+    ``x.claim(...)`` on a statically unknown receiver counts as the job
+    protocol's acquire exactly when at least one provider exists — the
+    same deliberate over-approximation thread-entry naming uses: missing
+    an acquire hides a leaked claim."""
+
+    def __init__(self, project) -> None:
+        self.project = project
+        self.providers: Dict[str, List[dict]] = {}
+        verbs = set(_ACQUIRE_VERBS) | set(_TERMINAL_VERBS)
+        for mod in sorted(project.modules.values(), key=lambda m: m.name):
+            ctx = mod.ctx
+            if not _is_library(ctx.rel_path):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and stmt.name in verbs:
+                        self.providers.setdefault(stmt.name, []).append({
+                            "method": f"{node.name}.{stmt.name}",
+                            "path": ctx.rel_path,
+                            "line": stmt.lineno,
+                        })
+
+    def acquire_protocol(self, verb: str) -> Optional[str]:
+        proto = _ACQUIRE_VERBS.get(verb)
+        return proto if proto and verb in self.providers else None
+
+    def terminal_protocol(self, verb: str) -> Optional[str]:
+        proto = _TERMINAL_VERBS.get(verb)
+        return proto if proto and verb in self.providers else None
+
+
+# ---------------------------------------------------------------------------
+# The flow
+# ---------------------------------------------------------------------------
+
+class ProtoFlow:
+    """Interprocedural typestate facts over the whole project.
+
+    Built once per project (see :func:`proto_flow`) and consumed by the
+    VMT132-135 rules and by :func:`build_proto_surface`.  All finding
+    lists hold plain dicts ``{"path", "line", "col", "message"[,
+    "flows"]}`` so rules stay thin adapters."""
+
+    def __init__(self, project) -> None:
+        self.project = project
+        self.cg = project.callgraph
+        self.registry = ProtocolRegistry(project)
+        # Leaf method name -> qualname iff unique among library functions
+        # (the LockFlow by-name fallback for self.* receivers).
+        self._mention_cache: Dict[tuple, bool] = {}
+        self._unique: Dict[str, Optional[str]] = {}
+        for fn in self.cg.functions.values():
+            if not _is_library(fn.module.ctx.rel_path):
+                continue
+            leaf = fn.scope[-1]
+            self._unique[leaf] = (
+                None if leaf in self._unique else fn.qualname)
+        self.summaries: Dict[str, _FnProto] = {}
+        for qual in sorted(self.cg.functions):
+            fn = self.cg.functions[qual]
+            if self._interesting(fn):
+                self.summaries[qual] = self._summarize(fn)
+        self._compose()
+        # Finding dicts, populated by the passes below.
+        self.job_findings: List[dict] = []
+        self.leak_findings: List[dict] = []
+        self.fault_findings: List[dict] = []
+        self.frame_findings: List[dict] = []
+        self.proof: List[dict] = []
+        self.fault_points: List[dict] = []
+        self._verify_functions()
+        self._check_fault_coverage()
+        self._check_terminal_frames()
+
+    # ------------------------------------------------------------ summaries
+    _VERBS = (set(_ACQUIRE_VERBS) | set(_TERMINAL_VERBS)
+              | {"start", "join", "connect", "close"})
+
+    def _module_mentions(self, mod, words: Set[str]) -> bool:
+        """Cheap text prefilter: can ``mod`` possibly contain one of
+        ``words`` as an identifier? Saves the per-function AST walk on
+        the model/engine bulk, which never touches protocol verbs."""
+        key = (id(mod), frozenset(words))
+        cached = self._mention_cache.get(key)
+        if cached is None:
+            src = mod.ctx.source
+            cached = any(w in src for w in words)
+            self._mention_cache[key] = cached
+        return cached
+
+    def _interesting(self, fn) -> bool:
+        if not _is_library(fn.module.ctx.rel_path):
+            return False
+        if not self._module_mentions(fn.module, self._VERBS):
+            return False
+        for node in self.cg._own_nodes(fn.node):
+            if isinstance(node, ast.Attribute) and node.attr in self._VERBS:
+                return True
+            if isinstance(node, ast.Name) and node.id in self._VERBS:
+                return True
+        return False
+
+    def _rel_path(self, qual: str) -> str:
+        return self.cg.functions[qual].module.ctx.rel_path
+
+    def _display(self, qual: str) -> str:
+        mod, scope = qual.split(":", 1)
+        return f"{mod}.{scope}"
+
+    def _resolve_call(self, fn, call: ast.Call) -> Optional[str]:
+        """Project callee of ``call``, with the by-name fallback for
+        unknown receivers (``self.queue.claim`` resolves nowhere, but a
+        project-unique ``_fail_job`` does)."""
+        qual = self.cg.resolve_callable(
+            fn.module, call.func, fn.scope, fn.cls_scope)
+        if qual is not None:
+            return qual
+        func = call.func
+        if isinstance(func, ast.Attribute) and not (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"):
+            qual = self._unique.get(func.attr)
+            if qual is not None and qual != fn.qualname:
+                return qual
+        return None
+
+    def _thread_vars(self, fn) -> Set[str]:
+        """Local names assigned a ``threading.Thread``/``Timer`` ctor."""
+        out: Set[str] = set()
+        ctx = fn.module.ctx
+        for node in self.cg._own_nodes(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and ctx.resolve(node.value.func) in _THREAD_CTORS:
+                out.add(node.targets[0].id)
+        return out
+
+    def _mentioned_names(self, call: ast.Call) -> Set[str]:
+        """Bare names a call touches — its receiver chain plus every
+        name inside its arguments (``q.ack(job.id)`` mentions ``job``)."""
+        names: Set[str] = set()
+        roots: List[ast.AST] = list(call.args)
+        roots.extend(kw.value for kw in call.keywords)
+        base = call.func
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        roots.append(base)
+        for root in roots:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Name):
+                    names.add(node.id)
+        return names
+
+    def _call_verbs(self, fn, call: ast.Call
+                    ) -> Iterator[Tuple[str, str, str]]:
+        """(kind, protocol, verb) protocol meanings of one call node."""
+        func = call.func
+        verb = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if verb is None:
+            return
+        proto = self.registry.acquire_protocol(verb)
+        if proto is not None:
+            yield "acquire", proto, verb
+        proto = self.registry.terminal_protocol(verb)
+        if proto is not None:
+            yield "terminal", proto, verb
+        if verb == "join" and isinstance(func, ast.Attribute):
+            yield "terminal", "thread", verb
+        if verb == "close" and isinstance(func, ast.Attribute):
+            yield "terminal", "sqlite", verb
+
+    def _summarize(self, fn) -> _FnProto:
+        info = _FnProto(fn)
+        ctx = fn.module.ctx
+        params = {a.arg for a in fn.node.args.args} - {"self"}
+        thread_vars = self._thread_vars(fn)
+        acquired_names: Dict[str, str] = {}  # local -> protocol
+        for node in self.cg._own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for kind, proto, verb in self._call_verbs(fn, node):
+                if kind == "acquire":
+                    info.acquire_calls.append(
+                        (proto, verb, node.lineno, node.col_offset))
+                    parent = ctx.parent(node)
+                    if isinstance(parent, ast.Assign) \
+                            and parent.value is node \
+                            and len(parent.targets) == 1 \
+                            and isinstance(parent.targets[0], ast.Name):
+                        acquired_names[parent.targets[0].id] = proto
+                elif kind == "terminal":
+                    if proto == "thread" and not (
+                            isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id in (thread_vars
+                                                       | params)):
+                        continue
+                    for name in self._mentioned_names(node) & params:
+                        info.terminal_params.setdefault(name, (proto, [
+                            _witness(ctx.rel_path, node.lineno,
+                                     f"`{verb}` — {proto}-protocol "
+                                     f"terminal"),
+                        ]))
+            # thread acquire: ``t.start()`` on a tracked thread value
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in thread_vars:
+                info.acquire_calls.append(
+                    ("thread", "start", node.lineno, node.col_offset))
+                acquired_names[node.func.value.id] = "thread"
+            # sqlite acquire: plain ``conn = sqlite3.connect(...)``
+            parent = ctx.parent(node)
+            if ctx.resolve(node.func) == "sqlite3.connect" \
+                    and isinstance(parent, ast.Assign) \
+                    and parent.value is node \
+                    and len(parent.targets) == 1 \
+                    and isinstance(parent.targets[0], ast.Name):
+                info.acquire_calls.append(
+                    ("sqlite", "connect", node.lineno, node.col_offset))
+                acquired_names[parent.targets[0].id] = "sqlite"
+        # acquire-return seed: ``return <acquire call>`` or ``return x``
+        # where x was bound by an acquire in this function.
+        for node in self.cg._own_nodes(fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if isinstance(node.value, ast.Call):
+                for kind, proto, verb in self._call_verbs(fn, node.value):
+                    if kind == "acquire":
+                        info.acquire_return = (proto, [_witness(
+                            ctx.rel_path, node.lineno,
+                            f"returns a freshly `{verb}`-ed "
+                            f"{proto} handle")])
+            elif isinstance(node.value, ast.Name) \
+                    and node.value.id in acquired_names:
+                info.acquire_return = (acquired_names[node.value.id], [
+                    _witness(ctx.rel_path, node.lineno,
+                             f"returns `{node.value.id}`, a fresh "
+                             f"{acquired_names[node.value.id]} handle")])
+        return info
+
+    # ------------------------------------------------------ composition
+    def _callee_param(self, callee_qual: str, call: ast.Call,
+                      arg: ast.AST) -> Optional[str]:
+        """Name of the callee parameter ``arg`` lands in."""
+        callee = self.cg.functions.get(callee_qual)
+        if callee is None:
+            return None
+        params = [a.arg for a in callee.node.args.args]
+        if callee.cls_scope and params and params[0] == "self" \
+                and isinstance(call.func, ast.Attribute):
+            params = params[1:]
+        for i, a in enumerate(call.args):
+            if a is arg:
+                return params[i] if i < len(params) else None
+        for kw in call.keywords:
+            if kw.value is arg and kw.arg is not None:
+                return kw.arg if kw.arg in params else None
+        return None
+
+    def _compose(self) -> None:
+        """Fixed point: propagate terminal-param and acquire-return
+        summaries through call edges (wrapper-of-wrapper chains)."""
+        for _ in range(len(self.summaries) + 1):
+            changed = False
+            for qual in sorted(self.summaries):
+                info = self.summaries[qual]
+                fn = info.fn
+                params = {a.arg for a in fn.node.args.args} - {"self"}
+                ctx = fn.module.ctx
+                for call in self.cg.own_call_nodes(fn):
+                    callee = self._resolve_call(fn, call)
+                    if callee is None or callee == qual:
+                        continue
+                    csum = self.summaries.get(callee)
+                    if csum is None:
+                        continue
+                    # terminal through a wrapper: f(job) where f nacks
+                    for arg in list(call.args) + [kw.value
+                                                  for kw in call.keywords]:
+                        if not isinstance(arg, ast.Name) \
+                                or arg.id not in params \
+                                or arg.id in info.terminal_params:
+                            continue
+                        pname = self._callee_param(callee, call, arg)
+                        if pname is None \
+                                or pname not in csum.terminal_params:
+                            continue
+                        proto, steps = csum.terminal_params[pname]
+                        info.terminal_params[arg.id] = (proto, [
+                            _witness(ctx.rel_path, call.lineno,
+                                     f"via `{self._display(callee)}`"),
+                        ] + steps)
+                        changed = True
+                    # acquire-return through a wrapper
+                    if info.acquire_return is None \
+                            and csum.acquire_return is not None:
+                        parent = ctx.parent(call)
+                        if isinstance(parent, ast.Return) \
+                                and parent.value is call:
+                            proto, steps = csum.acquire_return
+                            info.acquire_return = (proto, [_witness(
+                                ctx.rel_path, call.lineno,
+                                f"returns `{self._display(callee)}`"
+                                f"'s fresh {proto} handle")] + steps)
+                            changed = True
+            if not changed:
+                return
+
+    # ------------------------------------------------ event classification
+    def _classifier(self, fn):
+        """Per-event protocol ops for one function, memoized by event id.
+
+        Ops (state-independent; the consumers apply them to their own
+        domains):
+
+        * ``("acquire", protocol, token|None, line, verb, witness)``
+        * ``("terminal", token, line, verb, direct)``
+        * ``("escape", token, line)``
+        * ``("kill", token, line)`` — never emitted here; the path walk
+          synthesizes kills from ``is None`` branch refinement.
+        * ``("raise", None, line)`` / ``("return", None, line)``
+        """
+        ctx = fn.module.ctx
+        qual = fn.qualname
+        thread_vars = self._thread_vars(fn)
+        memo: Dict[int, List[tuple]] = {}
+
+        def classify(event) -> List[tuple]:
+            key = id(event)
+            if key in memo:
+                return memo[key]
+            ops: List[tuple] = []
+            if isinstance(event, ast.AST):
+                terminal_tokens: Set[str] = set()
+                acquire_nodes: Set[int] = set()
+                for node in iter_event_nodes(event):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    line = node.lineno
+                    handled = False
+                    for kind, proto, verb in self._call_verbs(fn, node):
+                        if kind == "acquire":
+                            token = self._binding(ctx, event, node)
+                            ops.append(("acquire", proto, token, line,
+                                        verb,
+                                        _witness(ctx.rel_path, line,
+                                                 f"`{verb}` acquires a "
+                                                 f"{proto} handle")))
+                            acquire_nodes.add(id(node))
+                            handled = True
+                        elif kind == "terminal":
+                            if proto == "thread" and not (
+                                    isinstance(node.func, ast.Attribute)
+                                    and isinstance(node.func.value,
+                                                   ast.Name)):
+                                continue
+                            for name in self._mentioned_names(node):
+                                ops.append(("terminal", name, line, verb,
+                                            True))
+                                terminal_tokens.add(name)
+                            handled = True
+                    if not handled:
+                        # thread/sqlite acquires + wrapper calls
+                        if isinstance(node.func, ast.Attribute) \
+                                and node.func.attr == "start" \
+                                and isinstance(node.func.value, ast.Name) \
+                                and node.func.value.id in thread_vars:
+                            ops.append((
+                                "acquire", "thread", node.func.value.id,
+                                line, "start",
+                                _witness(ctx.rel_path, line,
+                                         f"`{node.func.value.id}"
+                                         f".start()` starts a thread")))
+                            terminal_tokens.add(node.func.value.id)
+                            continue
+                        if ctx.resolve(node.func) == "sqlite3.connect":
+                            token = self._binding(ctx, event, node)
+                            if token is not None:
+                                ops.append((
+                                    "acquire", "sqlite", token, line,
+                                    "connect",
+                                    _witness(ctx.rel_path, line,
+                                             "`sqlite3.connect` opens a "
+                                             "connection")))
+                                acquire_nodes.add(id(node))
+                            continue
+                        callee = self._resolve_call(fn, node)
+                        csum = self.summaries.get(callee) \
+                            if callee and callee != qual else None
+                        if csum is None:
+                            continue
+                        if csum.acquire_return is not None:
+                            proto, steps = csum.acquire_return
+                            token = self._binding(ctx, event, node)
+                            ops.append(("acquire", proto, token, line,
+                                        self._display(callee),
+                                        _witness(ctx.rel_path, line,
+                                                 f"`{self._display(callee)}`"
+                                                 f" returns a fresh "
+                                                 f"{proto} handle")))
+                            acquire_nodes.add(id(node))
+                        for arg in list(node.args) + [
+                                kw.value for kw in node.keywords]:
+                            if not isinstance(arg, ast.Name):
+                                continue
+                            pname = self._callee_param(callee, node, arg)
+                            if pname is not None \
+                                    and pname in csum.terminal_params:
+                                ops.append(("terminal", arg.id, line,
+                                            self._display(callee), False))
+                                terminal_tokens.add(arg.id)
+                # escapes: a bare handle name flowing somewhere we do not
+                # model (returned, stored, aliased, passed to a callee
+                # with no terminal summary) ends our obligation to track
+                # it — under-approximate by design.
+                for name, line in self._escaped_names(ctx, event,
+                                                      terminal_tokens):
+                    ops.append(("escape", name, line))
+                if isinstance(event, ast.Raise):
+                    ops.append(("raise", None, event.lineno))
+                elif isinstance(event, ast.Return):
+                    ops.append(("return", None, event.lineno))
+            memo[key] = ops
+            return ops
+
+        return classify
+
+    @staticmethod
+    def _binding(ctx, event, call: ast.Call) -> Optional[str]:
+        """Local name an acquire call binds to (None when the handle is
+        returned straight through or dropped on the floor)."""
+        parent = ctx.parent(call)
+        if isinstance(parent, ast.Assign) and parent.value is call \
+                and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            return parent.targets[0].id
+        if isinstance(parent, ast.AnnAssign) and parent.value is call \
+                and isinstance(parent.target, ast.Name):
+            return parent.target.id
+        return None
+
+    @staticmethod
+    def _escaped_names(ctx, event, terminal_tokens: Set[str]
+                       ) -> Iterator[Tuple[str, int]]:
+        """Bare ``Name`` loads whose context gives the value away.
+
+        Attribute reads (``job.id``), comparisons (``job is None``) and
+        truthiness tests don't escape; anything else — call argument,
+        return value, store target value, subscript, container literal —
+        does.  Names already consumed by a terminal call in this same
+        event stay with the terminal classification."""
+        for node in iter_event_nodes(event):
+            if not isinstance(node, ast.Name) \
+                    or not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            if node.id in terminal_tokens:
+                continue
+            parent = ctx.parent(node)
+            if isinstance(parent, (ast.Attribute, ast.Compare)):
+                continue
+            if isinstance(parent, ast.UnaryOp) \
+                    and isinstance(parent.op, ast.Not):
+                continue
+            yield node.id, getattr(node, "lineno", 0)
+
+    # ------------------------------------------------------ path walking
+    def _wrapper_acquires(self, fn) -> Set[str]:
+        """Protocols ``fn`` acquires through composed wrappers — a call
+        whose callee summary returns a fresh handle (``self._claim()``
+        is a job acquire even though the verb is ``_claim``)."""
+        out: Set[str] = set()
+        for call in self.cg.own_call_nodes(fn):
+            callee = self._resolve_call(fn, call)
+            if callee is None or callee == fn.qualname:
+                continue
+            csum = self.summaries.get(callee)
+            if csum is not None and csum.acquire_return is not None:
+                out.add(csum.acquire_return[0])
+        return out
+
+    def _verify_functions(self) -> None:
+        # Every library function, not just the verb-mentioning ones the
+        # summary prefilter kept: a function whose only acquire is a
+        # composed wrapper call (``rep = self._checkout_for_dispatch()``)
+        # has no protocol verb in its own text. The text prefilter keeps
+        # the bulk of the tree out: a wrapper acquire needs the wrapper's
+        # leaf name somewhere in the module source.
+        wrapper_leaves = {
+            self.cg.functions[q].scope[-1]
+            for q, s in self.summaries.items() if s.acquire_return}
+        for qual in sorted(self.cg.functions):
+            fn = self.cg.functions[qual]
+            if not _is_library(fn.module.ctx.rel_path):
+                continue
+            info = self.summaries.get(qual)
+            if info is None and not (
+                    wrapper_leaves
+                    and self._module_mentions(fn.module, wrapper_leaves)):
+                continue
+            # A direct acquire verb would have made the function
+            # summary-interesting, so un-summarized functions can only
+            # acquire through wrappers.
+            acquired = ({p for p, _, _, _ in info.acquire_calls}
+                        if info else set())
+            acquired |= self._wrapper_acquires(fn)
+            if not acquired:
+                continue
+            try:
+                cfg = build_cfg(fn.node)
+            except RecursionError:  # pragma: no cover — pathological fns
+                continue
+            classify = self._classifier(fn)
+            self._check_exception_leaks(fn, cfg, classify)
+            if "job" in acquired:
+                self._verify_job_function(fn, cfg, classify)
+
+    # VMT133: must-held handles at a raise, via the worklist solver.
+    def _check_exception_leaks(self, fn, cfg: CFG, classify) -> None:
+        ctx = fn.module.ctx
+        analysis = _MustHeld(classify)
+        in_facts = solve(cfg, analysis)
+        acquire_site: Dict[str, tuple] = {}
+        for blk in cfg.reachable():
+            for event in blk.events:
+                for op in classify(event):
+                    if op[0] == "acquire" and op[1] != "job" \
+                            and op[2] is not None \
+                            and op[2] not in acquire_site:
+                        acquire_site[op[2]] = (op[1], op[3], op[5])
+        if not acquire_site:
+            return
+        seen: Set[tuple] = set()
+        for event, fact in iter_event_facts(cfg, analysis, in_facts):
+            if not isinstance(event, ast.Raise) or not fact:
+                continue
+            for token in sorted(fact):
+                if token not in acquire_site:
+                    continue
+                proto, aline, awit = acquire_site[token]
+                key = (token, event.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                verb = "/".join(PROTOCOLS[proto]["terminal"])
+                self.leak_findings.append({
+                    "path": ctx.rel_path,
+                    "line": event.lineno,
+                    "col": event.col_offset + 1,
+                    "message": (
+                        f"exception path abandons `{token}`, a "
+                        f"{proto} handle acquired at line {aline} and "
+                        f"never released — every raise that unwinds "
+                        f"this scope leaks it; call `{verb}` before "
+                        f"re-raising (or hand the handle off first)"),
+                    "flows": [[awit,
+                               _witness(ctx.rel_path, event.lineno,
+                                        f"raise escapes with `{token}` "
+                                        f"still held")]],
+                })
+
+    # VMT132: per-path terminal counting for job handles.
+    def _verify_job_function(self, fn, cfg: CFG, classify) -> None:
+        ctx = fn.module.ctx
+        if_tests: Dict[int, ast.If] = {}
+        for node in self.cg._own_nodes(fn.node):
+            if isinstance(node, ast.If):
+                if_tests[id(node.test)] = node
+        handler_entries = self._handler_entry_blocks(fn, cfg)
+        paths = 0
+        reported: Set[tuple] = set()
+        findings_before = len(self.job_findings)
+        # Path state: handles token -> [status, acquire_witness,
+        # terminal_witnesses, exc_since_terminal]; statuses: "held",
+        # "done", "dead" (claim-miss), "escaped".
+        stack: List[tuple] = [(cfg.entry, {}, frozenset(), False)]
+        while stack and paths < _MAX_PATHS:
+            blk, handles, visited, raised = stack.pop()
+            if blk.id in visited:
+                continue
+            visited = visited | {blk.id}
+            handles = {t: list(h) for t, h in handles.items()}
+            if blk.id in handler_entries:
+                # Crossing an exception edge: a terminal already counted
+                # may itself be the statement that raised mid-flight, so
+                # one compensating terminal is allowed without a
+                # double-terminal report.
+                for h in handles.values():
+                    if h[0] == "done":
+                        h[3] = True
+            for event in blk.events:
+                for op in classify(event):
+                    kind = op[0]
+                    if kind == "acquire" and op[1] == "job":
+                        token = op[2] if op[2] is not None \
+                            else f"<job@{op[3]}>"
+                        handles[token] = ["held", op[5], [], False]
+                    elif kind == "terminal":
+                        h = handles.get(op[1])
+                        if h is None:
+                            continue
+                        wit = _witness(ctx.rel_path, op[2],
+                                       f"terminal `{op[3]}`")
+                        if h[0] == "held" or (h[0] == "done" and h[3]):
+                            h[0], h[3] = "done", False
+                            h[2].append(wit)
+                        elif h[0] == "done" and op[4]:
+                            key = ("double", op[1],
+                                   h[2][-1]["line"], op[2])
+                            if key not in reported:
+                                reported.add(key)
+                                self.job_findings.append({
+                                    "path": ctx.rel_path,
+                                    "line": op[2],
+                                    "col": 1,
+                                    "message": (
+                                        f"double terminal for claimed "
+                                        f"job `{op[1]}`: this path "
+                                        f"already reached "
+                                        f"`{h[2][-1]['message']}` at "
+                                        f"line {h[2][-1]['line']} — a "
+                                        f"second ack/nack/release "
+                                        f"corrupts the queue row's "
+                                        f"lifecycle"),
+                                    "flows": [[h[1]] + h[2] + [wit]],
+                                })
+                            h[2].append(wit)
+                    elif kind == "escape":
+                        h = handles.get(op[1])
+                        if h is not None and h[0] == "held":
+                            h[0] = "escaped"
+                    elif kind == "raise":
+                        raised = True
+            if blk is cfg.exit or not blk.succs:
+                paths += 1
+                for token in sorted(handles):
+                    hstate, awit, terms, _ = handles[token]
+                    if hstate != "held":
+                        continue
+                    key = ("leak", token, awit["line"], raised)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    how = ("unwinds on an exception" if raised
+                           else "returns")
+                    self.job_findings.append({
+                        "path": ctx.rel_path,
+                        "line": awit["line"],
+                        "col": 1,
+                        "message": (
+                            f"leaked claim: a path from this `claim` "
+                            f"{how} without ever reaching ack/nack/"
+                            f"release for `{token}` — the job stays "
+                            f"inflight until the visibility sweep "
+                            f"guesses, instead of the protocol "
+                            f"deciding"),
+                        "flows": [[awit,
+                                   _witness(ctx.rel_path,
+                                            self._exit_line(fn, blk),
+                                            f"path {how} with `{token}`"
+                                            f" still claimed")]],
+                    })
+                continue
+            succs = blk.succs
+            refine = self._branch_refinement(blk, if_tests)
+            for i, succ in enumerate(reversed(succs)):
+                idx = len(succs) - 1 - i
+                nh = {t: list(h) for t, h in handles.items()}
+                if refine is not None:
+                    token, kill_on_true = refine
+                    h = nh.get(token)
+                    if h is not None and h[0] == "held" and (
+                            (idx == 0) == kill_on_true):
+                        h[0] = "dead"
+                stack.append((succ, nh, visited, raised))
+        verdict = "exactly-one" if len(self.job_findings) \
+            == findings_before else "violations"
+        if paths >= _MAX_PATHS:
+            verdict = "path-capped"
+        self.proof.append({
+            "function": self._display(fn.qualname),
+            "path": ctx.rel_path,
+            "paths": paths,
+            "verdict": verdict,
+        })
+
+    @staticmethod
+    def _exit_line(fn, blk: Block) -> int:
+        for event in reversed(blk.events):
+            line = getattr(event, "lineno", None)
+            if line:
+                return line
+        return getattr(fn.node, "lineno", 1)
+
+    @staticmethod
+    def _handler_entry_blocks(fn, cfg: CFG) -> Set[int]:
+        firsts: Set[int] = set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if handler.type is not None:
+                    firsts.add(id(handler.type))
+                elif handler.body:
+                    firsts.add(id(handler.body[0]))
+        out: Set[int] = set()
+        for blk in cfg.blocks:
+            if any(id(e) in firsts for e in blk.events):
+                out.add(blk.id)
+        return out
+
+    @staticmethod
+    def _branch_refinement(blk: Block, if_tests: Dict[int, ast.If]
+                           ) -> Optional[Tuple[str, bool]]:
+        """(token, kill_on_true_branch) for claim-miss guards: after
+        ``if job is None:`` the true branch has no handle to terminate."""
+        if len(blk.succs) < 2 or not blk.events:
+            return None
+        test = blk.events[-1]
+        if id(test) not in if_tests:
+            return None
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and isinstance(test.left, ast.Name) \
+                and len(test.comparators) == 1 \
+                and isinstance(test.comparators[0], ast.Constant) \
+                and test.comparators[0].value is None:
+            if isinstance(test.ops[0], ast.Is):
+                return test.left.id, True
+            if isinstance(test.ops[0], ast.IsNot):
+                return test.left.id, False
+        if isinstance(test, ast.Name):
+            return test.id, False
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+                and isinstance(test.operand, ast.Name):
+            return test.operand.id, True
+        return None
+
+    # ------------------------------------------------- VMT134 fault sites
+    def _check_fault_coverage(self) -> None:
+        rules: List[dict] = []
+        sites: List[dict] = []
+        for mod in sorted(self.project.modules.values(),
+                          key=lambda m: m.name):
+            ctx = mod.ctx
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else (
+                        node.func.id if isinstance(node.func, ast.Name)
+                        else None)
+                if name == "fault_point" and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and _is_library(ctx.rel_path):
+                    sites.append({"site": node.args[0].value,
+                                  "path": ctx.rel_path,
+                                  "line": node.lineno,
+                                  "col": node.col_offset + 1})
+                elif name == "FaultRule":
+                    pattern = None
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        pattern = node.args[0].value
+                    for kw in node.keywords:
+                        if kw.arg == "site" \
+                                and isinstance(kw.value, ast.Constant) \
+                                and isinstance(kw.value.value, str):
+                            pattern = kw.value.value
+                    if pattern is not None:
+                        rules.append({"pattern": pattern,
+                                      "path": ctx.rel_path,
+                                      "line": node.lineno})
+
+        def covers(pattern: str, site: str) -> bool:
+            if pattern.endswith("*"):
+                return site.startswith(pattern[:-1])
+            return pattern == site
+
+        for site in sorted(sites, key=lambda s: (s["path"], s["line"])):
+            covered = sorted(
+                ({"pattern": r["pattern"], "path": r["path"],
+                  "line": r["line"]}
+                 for r in rules if covers(r["pattern"], site["site"])),
+                key=lambda c: (c["path"], c["line"]))
+            self.fault_points.append({
+                "site": site["site"],
+                "path": site["path"],
+                "line": site["line"],
+                "covered_by": covered,
+            })
+            if not covered:
+                self.fault_findings.append({
+                    "path": site["path"],
+                    "line": site["line"],
+                    "col": site["col"],
+                    "message": (
+                        f"fault site `{site['site']}` is named by no "
+                        f"FaultPlan/FaultRule anywhere in tests/ or "
+                        f"scripts/ — chaos coverage silently drifted; "
+                        f"add a rule that injects here (or a `prefix.*`"
+                        f" rule that matches)"),
+                })
+
+    # --------------------------------------------- VMT135 terminal frames
+    def _check_terminal_frames(self) -> None:
+        machine = txn_flow(self.project).state_machines.get(
+            "jobs", {}).get("status")
+        if machine is None:
+            return
+        values = [v for v in machine["values"] if v is not None]
+        for mod in sorted(self.project.modules.values(),
+                          key=lambda m: m.name):
+            ctx = mod.ctx
+            if not _is_library(ctx.rel_path):
+                continue
+            for lit, node in self._status_literals(ctx):
+                if lit in values:
+                    continue
+                hint = difflib.get_close_matches(lit, values, n=1,
+                                                 cutoff=0.6)
+                suffix = (f"; did you mean '{hint[0]}'?" if hint
+                          else "")
+                self.frame_findings.append({
+                    "path": ctx.rel_path,
+                    "line": node.lineno,
+                    "col": node.col_offset + 1,
+                    "message": (
+                        f"job-status string '{lit}' is not a state of "
+                        f"the recovered jobs.status machine "
+                        f"({', '.join(repr(v) for v in values)}) — a "
+                        f"terminal frame or status check drifting from "
+                        f"the durable state machine compares against "
+                        f"nothing{suffix}"),
+                })
+
+    @staticmethod
+    def _status_literals(ctx) -> Iterator[Tuple[str, ast.AST]]:
+        """String literals used as a job *status*: compared against a
+        ``status`` name/attribute, stored under a ``"status"`` dict key,
+        or assigned to a ``status`` slot."""
+
+        def is_status(expr: ast.AST) -> bool:
+            return (isinstance(expr, ast.Name) and expr.id == "status") \
+                or (isinstance(expr, ast.Attribute)
+                    and expr.attr == "status")
+
+        def consts(expr: ast.AST) -> Iterator[ast.Constant]:
+            if isinstance(expr, ast.Constant) \
+                    and isinstance(expr.value, str):
+                yield expr
+            elif isinstance(expr, ast.IfExp):
+                yield from consts(expr.body)
+                yield from consts(expr.orelse)
+            elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+                for elt in expr.elts:
+                    yield from consts(elt)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq,
+                                                 ast.In, ast.NotIn)) \
+                    and is_status(node.left):
+                for c in consts(node.comparators[0]):
+                    yield c.value, c
+            elif isinstance(node, ast.Dict):
+                for key, value in zip(node.keys, node.values):
+                    if isinstance(key, ast.Constant) \
+                            and key.value == "status" and value is not None:
+                        for c in consts(value):
+                            yield c.value, c
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and is_status(node.targets[0]):
+                for c in consts(node.value):
+                    yield c.value, c
+
+
+def proto_flow(project) -> ProtoFlow:
+    flow = getattr(project, "_proto_flow", None)
+    if flow is None:
+        flow = ProtoFlow(project)
+        project._proto_flow = flow
+    return flow
+
+
+# ---------------------------------------------------------------------------
+# The committed surface
+# ---------------------------------------------------------------------------
+
+def build_proto_surface(project) -> dict:
+    """The protocol surface: every protocol with its states and sites,
+    the composed wrappers with witness chains, per-function path proofs,
+    and the fault coverage map.  Deterministic by construction (sorted
+    everywhere, no timestamps) so the rendering is byte-stable."""
+    flow = proto_flow(project)
+    protocols: Dict[str, dict] = {}
+    for name in sorted(PROTOCOLS):
+        decl = PROTOCOLS[name]
+        entry = {
+            "description": decl["description"],
+            "states": list(decl["states"]),
+            "acquire_verbs": sorted(decl["acquire"]),
+            "terminal_verbs": sorted(decl["terminal"]),
+            "declared_by": sorted(
+                (p for verb in decl["acquire"] + decl["terminal"]
+                 for p in flow.registry.providers.get(verb, ())),
+                key=lambda p: (p["path"], p["line"])),
+            "acquire_sites": [],
+            "wrappers": {"acquire": [], "terminal": []},
+        }
+        protocols[name] = entry
+    for qual in sorted(flow.summaries):
+        info = flow.summaries[qual]
+        rel = flow._rel_path(qual)
+        fn_name = flow._display(qual)
+        for proto, verb, line, _col in sorted(info.acquire_calls,
+                                              key=lambda a: a[2]):
+            protocols[proto]["acquire_sites"].append(
+                {"function": fn_name, "path": rel, "line": line,
+                 "verb": verb})
+        if info.acquire_return is not None:
+            proto, steps = info.acquire_return
+            protocols[proto]["wrappers"]["acquire"].append(
+                {"function": fn_name, "witness": steps})
+        for pname in sorted(info.terminal_params):
+            proto, steps = info.terminal_params[pname]
+            protocols[proto]["wrappers"]["terminal"].append(
+                {"function": fn_name, "param": pname, "witness": steps})
+    surface = {
+        "version": PROTO_VERSION,
+        "generator": "vmtlint proto",
+        "protocols": protocols,
+        "proof": sorted(flow.proof,
+                        key=lambda p: (p["path"], p["function"])),
+        "fault_points": flow.fault_points,
+        "counts": {
+            "protocols": len(protocols),
+            "acquire_sites": sum(len(p["acquire_sites"])
+                                 for p in protocols.values()),
+            "wrappers": sum(len(p["wrappers"]["acquire"])
+                            + len(p["wrappers"]["terminal"])
+                            for p in protocols.values()),
+            "functions_proved": len(flow.proof),
+            "fault_points": len(flow.fault_points),
+        },
+    }
+    return surface
+
+
+def render_proto_surface(surface: dict) -> str:
+    return json.dumps(surface, indent=2, sort_keys=True) + "\n"
+
+
+def diff_proto_surface(committed: Optional[dict], fresh: dict
+                       ) -> List[str]:
+    """Human-readable drift between the committed manifest and a fresh
+    build — empty when they agree."""
+    if committed is None:
+        return [f"{MANIFEST_NAME} missing — run `vmtlint proto` and "
+                f"commit it"]
+    msgs: List[str] = []
+    if committed.get("version") != fresh.get("version"):
+        msgs.append(f"manifest version drifted: committed "
+                    f"{committed.get('version')!r}, tree expects "
+                    f"{fresh.get('version')!r}")
+        return msgs
+    cp = committed.get("protocols", {})
+    fp = fresh.get("protocols", {})
+    for name in sorted(set(cp) | set(fp)):
+        if name not in cp:
+            msgs.append(f"protocol `{name}` is new in the tree")
+            continue
+        if name not in fp:
+            msgs.append(f"protocol `{name}` is gone from the tree")
+            continue
+        csites = {(s["path"], s["line"], s["verb"])
+                  for s in cp[name].get("acquire_sites", [])}
+        fsites = {(s["path"], s["line"], s["verb"])
+                  for s in fp[name].get("acquire_sites", [])}
+        for path, line, verb in sorted(fsites - csites):
+            msgs.append(f"`{name}` acquire site is new: `{verb}` at "
+                        f"{path}:{line}")
+        for path, line, verb in sorted(csites - fsites):
+            msgs.append(f"`{name}` acquire site is gone: `{verb}` at "
+                        f"{path}:{line}")
+    csites = {(s["site"], s["path"]) for s in
+              committed.get("fault_points", [])}
+    fsites = {(s["site"], s["path"]) for s in fresh.get("fault_points", [])}
+    for site, path in sorted(fsites - csites):
+        msgs.append(f"fault site `{site}` ({path}) is new in the tree")
+    for site, path in sorted(csites - fsites):
+        msgs.append(f"fault site `{site}` ({path}) is gone from the tree")
+    cverd = {p["function"]: p["verdict"]
+             for p in committed.get("proof", [])}
+    fverd = {p["function"]: p["verdict"] for p in fresh.get("proof", [])}
+    for fn_name in sorted(set(cverd) | set(fverd)):
+        if cverd.get(fn_name) != fverd.get(fn_name):
+            msgs.append(f"proof verdict for `{fn_name}` drifted: "
+                        f"{cverd.get(fn_name)!r} -> "
+                        f"{fverd.get(fn_name)!r}")
+    if not msgs and committed != fresh:
+        msgs.append("manifest metadata drifted (witness lines moved?)")
+    return msgs
+
+
+# ---------------------------------------------------------------------------
+# SARIF rendering
+# ---------------------------------------------------------------------------
+
+def _sarif_loc(w: dict) -> dict:
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": w["path"]},
+            "region": {"startLine": max(1, int(w.get("line", 1)))},
+        },
+        "message": {"text": w.get("message", "")},
+    }
+
+
+def _sarif_flow(steps: List[dict]) -> dict:
+    return {"threadFlows": [{
+        "locations": [{"location": _sarif_loc(s)} for s in steps],
+    }]}
+
+
+def render_proto_surface_sarif(surface: dict) -> str:
+    """The surface as SARIF note-level results: one per acquire site
+    (with the composed wrapper witnesses as codeFlows) and one per
+    fault site."""
+    results: List[dict] = []
+    for name in sorted(surface.get("protocols", {})):
+        proto = surface["protocols"][name]
+        wrapper_flows = [
+            _sarif_flow(w["witness"])
+            for group in ("acquire", "terminal")
+            for w in proto["wrappers"][group] if w.get("witness")
+        ]
+        for site in proto.get("acquire_sites", []):
+            result = {
+                "ruleId": "PROTO-SURFACE",
+                "level": "note",
+                "message": {"text": (
+                    f"{name} protocol acquire `{site['verb']}` in "
+                    f"`{site['function']}`")},
+                "locations": [_sarif_loc({
+                    "path": site["path"], "line": site["line"],
+                    "message": f"`{site['verb']}` acquire"})],
+            }
+            if wrapper_flows:
+                result["codeFlows"] = wrapper_flows
+            results.append(result)
+    for site in surface.get("fault_points", []):
+        covered = ", ".join(c["pattern"] for c in site["covered_by"]) \
+            or "NOTHING"
+        results.append({
+            "ruleId": "PROTO-FAULT-POINT",
+            "level": "note",
+            "message": {"text": (
+                f"fault site `{site['site']}` covered by: {covered}")},
+            "locations": [_sarif_loc({
+                "path": site["path"], "line": site["line"],
+                "message": f"fault_point(\"{site['site']}\")"})],
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "vmtlint-proto",
+                "informationUri": "",
+                "rules": [
+                    {"id": "PROTO-SURFACE",
+                     "shortDescription": {
+                         "text": "protocol acquire site"}},
+                    {"id": "PROTO-FAULT-POINT",
+                     "shortDescription": {
+                         "text": "fault-injection site coverage"}},
+                ],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,150 @@
+"""VMT132–135: typestate protocol rules over the proto tier.
+
+The load-bearing serving invariant — a claimed job reaches **exactly
+one** terminal — was until now enforced only dynamically, by chaos soaks
+that sample a handful of paths per run. These rules re-anchor the
+findings :class:`analysis.proto.ProtoFlow` precomputes project-wide
+(path-exhaustive typestate proofs over the CFG, composed through the
+call graph) — the same cached-flow consumption shape as the VMT119/120
+lock rules and the VMT128-131 txn rules.
+
+All four are ``library_only``: tests claim-and-drop on purpose (that is
+what a fixture *is*), so the protocol obligations bind only the package.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.core import Finding, Rule
+from vilbert_multitask_tpu.analysis.locks import _Anchor
+from vilbert_multitask_tpu.analysis.proto import proto_flow
+
+
+class JobTerminalProtocol(Rule):
+    """A claim path reaching zero terminals (leak) or two (double).
+
+    The typestate walk enumerates every acyclic CFG path from each
+    ``claim`` — exception edges and early-return unwinds included —
+    refining ``if job is None`` claim-miss guards per branch and
+    treating returned/stored/passed-on handles as the callee's
+    obligation. Both witness chains render as SARIF codeFlows.
+    """
+
+    id = "VMT132"
+    name = "job-terminal-protocol"
+    severity = "error"
+    library_only = True
+    description = ("a control-flow path from a job claim reaches zero "
+                   "terminals (leaked claim: the visibility sweep, not "
+                   "the protocol, decides the job's fate) or two "
+                   "(double terminal: the queue row's lifecycle is "
+                   "corrupted)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = proto_flow(ctx.project)
+        for e in flow.job_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            f = self.finding(ctx, _Anchor(e["line"], e["col"]),
+                             e["message"])
+            f.flows = [list(chain) for chain in e["flows"]]
+            yield f
+
+
+class ResourceLeakOnException(Rule):
+    """An exception edge escapes a scope still holding a handle.
+
+    The flow-sensitive upgrade of VMT117: the worklist solver runs a
+    must-held domain (join = intersection) over the CFG, so a ``raise``
+    whose incoming fact still contains a checked-out replica, a
+    started-unjoined thread, or a plain (non-``with``) sqlite
+    connection is a leak on that exact path — not a heuristic about
+    syntax shape.
+    """
+
+    id = "VMT133"
+    name = "resource-leak-on-exception"
+    severity = "error"
+    library_only = True
+    description = ("an exception path abandons an unreleased handle — "
+                   "checkout without checkin, started thread without "
+                   "join, sqlite connection without close")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = proto_flow(ctx.project)
+        for e in flow.leak_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            f = self.finding(ctx, _Anchor(e["line"], e["col"]),
+                             e["message"])
+            f.flows = [list(chain) for chain in e["flows"]]
+            yield f
+
+
+class FaultPointCoverage(Rule):
+    """Every ``fault_point`` site must be named by some FaultRule.
+
+    A project-graph cross-check: the chaos tier's value is coverage, and
+    coverage silently drifts the moment someone adds a fault site
+    without a FaultPlan that injects there. A subset scan cannot prove a
+    site is covered *nowhere*, so ``--changed`` suppresses this rule via
+    ``partial_scan`` (the VMT122/VMT130 dead-direction contract).
+    """
+
+    id = "VMT134"
+    name = "fault-point-coverage"
+    severity = "warning"
+    library_only = True
+    description = ("a resilience.faults.fault_point site named by no "
+                   "FaultPlan/FaultRule in tests/ or scripts/ — chaos "
+                   "coverage drifted")
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Set by the --changed driver: coverage needs the whole project.
+        self.partial_scan = False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None or self.partial_scan:
+            return
+        flow = proto_flow(ctx.project)
+        for e in flow.fault_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])
+
+
+class TerminalFrameDrift(Rule):
+    """Job-status strings cross-checked against the recovered machine.
+
+    The txn tier already recovers the ``jobs.status`` state machine from
+    the SQL surface (TXN_SURFACE.json). Any status literal the runtime
+    compares, stores, or pushes through the frame hub that is not a
+    state of that machine compares against nothing — with did-you-mean,
+    because these bugs are almost always one-letter drift.
+    """
+
+    id = "VMT135"
+    name = "terminal-frame-drift"
+    severity = "warning"
+    library_only = True
+    description = ("a job-status string literal that is not a state of "
+                   "the recovered jobs.status machine — a terminal "
+                   "frame or status check drifting from durable state")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = proto_flow(ctx.project)
+        for e in flow.frame_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])

@@ -517,6 +517,16 @@ class SwallowedException(Rule):
     In a worker/queue hot loop this turns a poisoned job or a dying
     backend into silent job loss. Narrow exception types are fine;
     ``__del__``/``__exit__`` teardown (where raising is worse) is exempt.
+
+    CFG-aware since the proto tier landed: a ``pass`` handler whose
+    continuation still *does* something — reaches any call or a valued
+    return before falling off the function or looping back — is a
+    deliberate "degrade and carry on" recovery path, not a swallow. The
+    walk stops at the try body's own statements and at the enclosing
+    loop's header, so "reaches work" means work *after* the handler, not
+    the next iteration's re-attempt. All-``continue`` handlers keep
+    firing unconditionally (their continuation is by definition the next
+    iteration).
     """
 
     id = "VMT107"
@@ -539,6 +549,10 @@ class SwallowedException(Rule):
             fn = ctx.enclosing_function(node)
             if fn is not None and fn.name in self._TEARDOWN:
                 continue
+            if all(isinstance(s, ast.Pass) for s in node.body) \
+                    and fn is not None \
+                    and self._continuation_works(ctx, fn, node):
+                continue
             caught = ("bare except" if node.type is None
                       else f"except {ctx.resolve(node.type)}")
             yield self.finding(
@@ -546,6 +560,63 @@ class SwallowedException(Rule):
                 f"`{'pass' if isinstance(node.body[0], ast.Pass) else 'continue'}`"
                 f" — in a hot loop this silently drops jobs; catch the "
                 f"specific exception or at least log it")
+
+    @staticmethod
+    def _continuation_works(ctx: ModuleContext, fn: ast.AST,
+                            handler: ast.ExceptHandler) -> bool:
+        """True when the path leaving ``handler`` still reaches a call
+        or a valued return inside ``fn`` — without re-entering the try
+        body or crossing the enclosing loop's header."""
+        from vilbert_multitask_tpu.analysis.cfg import (
+            build_cfg, iter_event_nodes)
+        try:
+            cfg = build_cfg(fn)
+        except RecursionError:  # pragma: no cover
+            return False
+        tries = [a for a in ctx.ancestors(handler)
+                 if isinstance(a, ast.Try) and handler in a.handlers]
+        if not tries:
+            return False
+        body_ids = {id(n) for stmt in tries[0].body
+                    for n in ast.walk(stmt)}
+        loop = next((a for a in ctx.ancestors(tries[0])
+                     if isinstance(a, (ast.While, ast.For))
+                     and ctx.enclosing_function(a) is fn), None)
+        loop_head_ids: Set[int] = set()
+        if loop is not None:
+            if isinstance(loop, ast.While):
+                loop_head_ids.add(id(loop.test))
+            else:
+                loop_head_ids.update((id(loop.iter), id(loop.target)))
+        start = next((blk for blk in cfg.blocks
+                      if any(e is handler.body[-1] for e in blk.events)),
+                     None)
+        if start is None:
+            return False
+        seen = {start.id}
+        frontier = [start]
+        first = True
+        while frontier:
+            blk = frontier.pop()
+            for event in blk.events:
+                if first and blk is start:
+                    # Skip events up to and including the handler body.
+                    continue
+                if id(event) in body_ids or id(event) in loop_head_ids:
+                    break
+                if isinstance(event, ast.Return) \
+                        and event.value is not None:
+                    return True
+                if any(isinstance(n, ast.Call)
+                       for n in iter_event_nodes(event)):
+                    return True
+            else:
+                for succ in blk.succs:
+                    if succ.id not in seen:
+                        seen.add(succ.id)
+                        frontier.append(succ)
+            first = False
+        return False
 
 
 # --------------------------------------------------------------------- 108
@@ -1998,6 +2069,9 @@ from vilbert_multitask_tpu.analysis.shaperules import (  # noqa: E402
     UnboundedCompileKey)
 from vilbert_multitask_tpu.analysis.txnrules import (  # noqa: E402
     MultiWriteNoTxn, NondeterministicClaim, RmwDeferredTxn, SqlSchemaDrift)
+from vilbert_multitask_tpu.analysis.protorules import (  # noqa: E402
+    FaultPointCoverage, JobTerminalProtocol, ResourceLeakOnException,
+    TerminalFrameDrift)
 
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
@@ -2009,7 +2083,8 @@ RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          JitClosureCapture, ConfigKnobDrift, InstrumentNameDrift,
          UnboundedCompileKey, DtypePromotionLeak, PartitionRankMismatch,
          BucketShapeDrift, RmwDeferredTxn, MultiWriteNoTxn, SqlSchemaDrift,
-         NondeterministicClaim]
+         NondeterministicClaim, JobTerminalProtocol,
+         ResourceLeakOnException, FaultPointCoverage, TerminalFrameDrift]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

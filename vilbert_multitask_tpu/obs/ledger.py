@@ -113,11 +113,13 @@ def key_direction(key: str) -> Optional[str]:
     """'lower' / 'higher' is-better, or None for ungated keys."""
     if key in _META_KEYS or not isinstance(key, str):
         return None
-    if key.endswith(("_ms", "_s")) or "latency" in key:
-        return "lower"
+    # Throughputs first: "_per_s" also ends with "_s", and a rate that
+    # went UP must never gate as a latency regression.
     if (key.endswith(("qps", "_per_s", "_rows", "speedup"))
             or key == "value" or key == "knee_rows"):
         return "higher"
+    if key.endswith(("_ms", "_s")) or "latency" in key:
+        return "lower"
     return None
 
 

@@ -253,6 +253,24 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
     # support now that a query is ~100ms, not 24s.
     epochs = max(1, min(30, int(budget_s / max(per_pass_s, 1e-3))))
     lat_ms, fwd_ms, dec_ms, tflops = [], [], [], []
+    # Ride the cost-attribution plane through the timed loop: each query
+    # is a single-member batch (the latency bench's serving shape), so
+    # device_s_conservation must come back 1.0 — the plumbing smoke — and
+    # tail_kept_frac reports what the sampler kept of a real workload.
+    # Best-effort: a store failure must never cost the headline p50.
+    attrib = store = None
+    try:
+        import tempfile as _tempfile
+
+        from vilbert_multitask_tpu import obs
+
+        store = obs.TraceStore(os.path.join(
+            _tempfile.mkdtemp(prefix="bench_attrib_"), "traces.sqlite3"),
+            "bench")
+        attrib = obs.CostAttributor(
+            ring=8192, on_finish=lambda c: store.offer(c))
+    except Exception as e:  # noqa: BLE001 — bonus metric only
+        print(f"# cost-attrib smoke disabled: {e}", file=sys.stderr)
     # Live view beside the lifetime percentiles: the same sliding-window
     # aggregation the serving SLOs run on (obs.Histogram.window_percentile)
     # over the trailing slice of the run — on a long bench this is "what a
@@ -262,7 +280,7 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
                      reservoir=4096)
     live_window_s = 30.0
     for _ in range(epochs):
-        for req in reqs:
+        for (task_id, _q, _n), req in zip(ROUND_ROBIN, reqs):
             t = time.perf_counter()
             engine.run(req)
             lat_ms.append((time.perf_counter() - t) * 1e3)
@@ -270,6 +288,15 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
             fwd_s = engine.stage_times.get("forward_s", 0.0)
             fwd_ms.append(fwd_s * 1e3)
             dec_ms.append(engine.stage_times.get("decode_s", 0.0) * 1e3)
+            if attrib is not None:
+                tid = f"bench{len(lat_ms):06d}"
+                attrib.begin(tid, task=str(task_id))
+                attrib.charge_batch(fwd_s, [(tid, req.n_images)],
+                                    batch_rows=req.n_images,
+                                    bucket=req.bucket)
+                attrib.charge(tid, "decode",
+                              engine.stage_times.get("decode_s", 0.0))
+                attrib.finish(tid, "ok")
             # Achieved FLOP/s for THIS query's compiled bucket (padding rows
             # count — they're real MXU work the bucketing strategy pays for).
             flops = serving_forward_flops(cfg.model, cfg.engine, req.bucket)
@@ -330,6 +357,10 @@ def _measure(engine, cfg, *, budget_s: float = 45.0):
             "forward": round(percentile(fwd_ms, 0.5), 3),
             "decode": round(percentile(dec_ms, 0.5), 3),
         },
+        "cost_attrib": ({
+            "device_s_conservation": attrib.conservation()["ratio"],
+            "tail_kept_frac": store.stats()["tail_kept_frac"],
+        } if attrib is not None else None),
     }
 
 
@@ -680,6 +711,8 @@ def run_measurement() -> None:
         "decode_p50_ms": stats["decode_p50_ms"],
         "stage_ms": stats["stage_ms"],
         "dispatch_floor_ms": stats["dispatch_floor_ms"],
+        **({"cost_attrib": stats["cost_attrib"]}
+           if stats.get("cost_attrib") else {}),
         **anatomy,
         "param_bytes": param_bytes,
         "param_dtype": cfg.engine.param_dtype,

@@ -102,10 +102,20 @@ echo "== tier-1 tests (fast profile) =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider || fail=1
 
+echo "== conservation smoke (plain soak: attributed device-s vs busy wall) =="
+# Short fault-free soak for the cost-attribution double-entry gate: the
+# summed per-job device shares must land within 10% of the engine busy
+# wall (chaos runs legitimately strand shares on failed batches, so the
+# conservation gate only runs here), and the tail sampler's keep stats
+# ride the report into the perf ledger (soak.attrib).
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --jobs 20 \
+  --out /tmp/PLAIN_SOAK.json || fail=1
+
 echo "== chaos smoke (seeded FaultPlan, no-lost-jobs invariant) =="
 # Short end-to-end soak under injected faults: every submitted job must
 # reach exactly one terminal state (result / dead-letter / deadline push),
-# and the flight recorder must capture an injected fault's trace.
+# every failed job must have a stored trace for its autopsy, and the
+# flight recorder must capture an injected fault's trace.
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --chaos --jobs 15 \
   --out /tmp/CHAOS_SOAK.json || fail=1
 

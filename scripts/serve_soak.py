@@ -85,6 +85,25 @@ def _ledger_verdict(report: dict, verdict: bool,
         print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
 
 
+def _ledger_attrib(report: dict, verdict: bool) -> None:
+    """Ledger the cost-attribution verdict under its own metric: the
+    conservation ratio and tail-kept fraction trend independently of
+    qps, and check() baselines are per-metric medians."""
+    try:
+        from vilbert_multitask_tpu import obs
+
+        ca = report.get("cost_attrib") or {}
+        values = {k: v for k, v in ca.items()
+                  if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        if values:
+            obs.ledger_append("soak.attrib", values, extra={
+                "verdict": "pass" if verdict else "fail",
+                "chaos": "chaos" in report,
+            })
+    except Exception as e:  # noqa: BLE001 — ride-along must never fail the soak
+        print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
+
+
 def _build_cfg(root: str, full: bool):
     from vilbert_multitask_tpu.config import (
         EngineConfig,
@@ -661,6 +680,7 @@ def main(argv=None) -> int:
     conn = http.client.HTTPConnection("127.0.0.1", app.http_port,
                                       timeout=30)
     submitted: dict = {}
+    trace_by_q: dict = {}  # question → trace_id (the attribution key)
     t_burst = time.perf_counter()
     for i in range(args.jobs):
         task_id, q_t, n_img = PATTERN[i % len(PATTERN)]
@@ -678,7 +698,7 @@ def main(argv=None) -> int:
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         assert resp.status == 200, resp.read()
-        resp.read()
+        trace_by_q[q.lower()] = json.loads(resp.read()).get("trace_id", "")
         submitted[q.lower()] = t_submit
 
     ok = done.wait(timeout=600)
@@ -765,6 +785,18 @@ def main(argv=None) -> int:
         "batches_dispatched": int(BATCHES_DISPATCHED.value()),
         "shed_expired": int(SHED_COUNTER.value(reason="deadline")),
     }
+    # Cost-attribution verdict: the double-entry ledgers must agree — the
+    # sum of per-job device shares stays within 10% of the engine busy
+    # wall on a plain run (chaos legitimately strands shares on failed
+    # batches, so there it is reported, not gated).
+    cost_attrib = {"enabled": app.attrib is not None}
+    if app.attrib is not None:
+        cons = app.attrib.conservation()
+        cost_attrib.update(
+            busy_s=cons["busy_s"], attributed_s=cons["attributed_s"],
+            device_s_conservation=cons["ratio"],
+            tail_kept_frac=app.tracestore.stats()["tail_kept_frac"])
+    report["cost_attrib"] = cost_attrib
     if args.chaos:
         state_counts: dict = {}
         for state in terminals.values():
@@ -795,6 +827,18 @@ def main(argv=None) -> int:
                 fault_bundle = os.path.basename(path)
                 trace_in_spans = True
                 break
+        # Tail-sampling acceptance: every job that died (dead-letter or
+        # deadline shed) is a non-ok verdict the store keeps at 100% —
+        # each must be readable back as a stored trace for its autopsy.
+        # app.stop() ran the final flush above, so the rows are on disk.
+        unstored = []
+        if app.tracestore is not None:
+            for q, state in terminals.items():
+                if state in ("dead", "deadline"):
+                    tid = trace_by_q.get(q, "")
+                    if not tid or app.tracestore.get(tid) is None:
+                        unstored.append(q)
+        failed_traces_stored = app.tracestore is not None and not unstored
         report["chaos"] = {
             "seed": args.seed,
             "injections": plan.injections(),
@@ -804,6 +848,7 @@ def main(argv=None) -> int:
             "no_job_lost": no_job_lost,
             "exactly_one_terminal": exactly_one,
             "duplicates": dup_terminals,
+            "failed_jobs_without_stored_trace": unstored,
             "flight_recorder": {
                 "bundles": len(bundles),
                 "fault_bundle": fault_bundle,
@@ -816,10 +861,14 @@ def main(argv=None) -> int:
         # injected intake faults, so all_completed is not the bar here —
         # and the flight recorder captured an injected fault's trace.
         verdict = (no_job_lost and exactly_one and len(faulted) >= 3
-                   and trace_in_spans)
+                   and trace_in_spans and failed_traces_stored)
     else:
-        verdict = report["all_completed"]
+        cons_ok = (not cost_attrib["enabled"]
+                   or abs(cost_attrib["device_s_conservation"] - 1.0)
+                   <= 0.10)
+        verdict = report["all_completed"] and cons_ok
     _ledger_verdict(report, verdict)
+    _ledger_attrib(report, verdict)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report), flush=True)

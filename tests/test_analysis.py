@@ -869,6 +869,70 @@ def test_quant_module_itself_is_exempt():
         src, path="vilbert_multitask_tpu/quant.py")
 
 
+# ----------------------------------------------------------------- VMT136
+def test_exemplar_observe_with_request_derived_label_triggers():
+    # An exemplar-carrying observe() whose label is derived from request
+    # data mints one exemplar-bearing series per distinct value.
+    src = """
+    from vilbert_multitask_tpu import obs
+
+    HIST = obs.REGISTRY.histogram("lat_ms", "latency", (1.0, 10.0))
+
+    def record(rows, latency_ms, trace_id):
+        n = len(rows)
+        HIST.observe(latency_ms, exemplar_trace_id=trace_id, rows=n)
+    """
+    fs = [f for f in findings(src) if f.rule == "VMT136"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert "label `rows`" in f.message
+    assert "bounded vocabulary" in f.message
+    assert f.flows and f.flows[0][-1]["message"].startswith(
+        "flows into label `rows`")
+
+
+def test_exemplar_observe_with_param_label_triggers():
+    src = """
+    from vilbert_multitask_tpu import obs
+
+    HIST = obs.REGISTRY.histogram("lat_ms", "latency", (1.0, 10.0))
+
+    def record(latency_ms, trace_id, tenant):
+        HIST.observe(latency_ms, exemplar_trace_id=trace_id, tenant=tenant)
+    """
+    assert "VMT136" in rules_hit(src)
+
+
+def test_exemplar_observe_with_bounded_labels_is_clean():
+    # Literal labels, and task ids routed through str() on the way to the
+    # label (metrics.Metrics.record's actual shape), stay clean: the task
+    # registry bounds the vocabulary, not the request.
+    src = """
+    from vilbert_multitask_tpu import obs
+
+    HIST = obs.REGISTRY.histogram("lat_ms", "latency", (1.0, 10.0))
+
+    def record(task_id, latency_ms, trace_id):
+        HIST.observe(latency_ms, exemplar_trace_id=trace_id,
+                     stage="forward", task=str(task_id))
+    """
+    assert "VMT136" not in rules_hit(src)
+
+
+def test_exemplarless_observe_with_param_label_is_clean():
+    # Without an exemplar the observe() is ordinary label traffic; other
+    # rules own plain cardinality, VMT136 only guards exemplar slots.
+    src = """
+    from vilbert_multitask_tpu import obs
+
+    HIST = obs.REGISTRY.histogram("lat_ms", "latency", (1.0, 10.0))
+
+    def record(latency_ms, tenant):
+        HIST.observe(latency_ms, tenant=tenant)
+    """
+    assert "VMT136" not in rules_hit(src)
+
+
 # ----------------------------------------------- suppressions and baseline
 def test_inline_suppression_by_id_name_and_next_line():
     base = """

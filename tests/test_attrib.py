@@ -1,0 +1,313 @@
+"""Cost-attribution plane unit tests: amortization math (double-entry
+conservation, mid-batch failure waste), windowed aggregates, the
+module-plane disabled-mode overhead guard, tail-sampling keep policy and
+durability of the trace store, histogram exemplars, and the OpenMetrics
+golden rendering."""
+
+import json
+import time
+
+from vilbert_multitask_tpu.obs import (
+    OPENMETRICS_CONTENT_TYPE,
+    CostAttributor,
+    JobCost,
+    Registry,
+    Tracer,
+    TraceStore,
+    get_attributor,
+    job_batch,
+    job_begin,
+    job_charge,
+    job_finish,
+    render_openmetrics,
+    set_attributor,
+)
+
+
+# ----------------------------------------------------------- attrib math
+def test_stage_charges_accumulate_and_negative_clamps():
+    a = CostAttributor()
+    a.begin("t1", job_id=7, task="vqa", tenant="acme")
+    a.charge("t1", "intake", 0.010)
+    a.charge("t1", "intake", 0.005)
+    a.charge("t1", "decode", -3.0)  # clock skew never goes negative
+    cost = a.finish("t1", "ok")
+    assert cost is not None
+    assert cost.job_id == 7 and cost.task == "vqa" and cost.tenant == "acme"
+    assert cost.stages["intake"] == 15.0
+    assert cost.stages["decode"] == 0.0
+    assert cost.verdict == "ok"
+    assert cost.total_ms() == 15.0
+    # Closed records stay readable from the done ring.
+    assert a.get("t1") is cost
+    # Unknown stages/traces are inert, not errors.
+    a.charge("nope", "intake", 1.0)
+    assert a.finish("nope", "ok") is None
+
+
+def test_batch_amortization_mixed_rows_conserves_exactly():
+    a = CostAttributor()
+    a.begin("big", task="vqa")
+    a.begin("small", task="retrieval")
+    a.charge_batch(2.0, [("big", 3), ("small", 1)], batch_rows=4,
+                   bucket=4, replica="rep0")
+    big, small = a.get("big"), a.get("small")
+    assert big.device_s == 1.5 and small.device_s == 0.5
+    assert big.stages["forward"] == 1500.0
+    assert big.bucket == "4" and big.replica == "rep0"
+    assert big.member_rows == 3 and big.batch_rows == 4
+    # Every member streamed: the two ledgers agree exactly.
+    cons = a.conservation()
+    assert cons == {"busy_s": 2.0, "attributed_s": 2.0, "ratio": 1.0}
+
+
+def test_mid_batch_failure_charges_streamed_only():
+    a = CostAttributor()
+    a.begin("ok1", task="vqa")
+    a.begin("dead1", task="vqa")
+    # Only the streamed member is listed; the dead one's share stays on
+    # the busy ledger as visible waste.
+    a.charge_batch(1.0, [("ok1", 1)], batch_rows=4)
+    assert a.get("ok1").device_s == 0.25
+    assert a.get("dead1").device_s == 0.0
+    cons = a.conservation()
+    assert cons["busy_s"] == 1.0 and cons["attributed_s"] == 0.25
+    assert cons["ratio"] == 0.25
+
+
+def test_empty_ledgers_report_ratio_one():
+    # No dispatches yet must read as "conserved", not divide-by-zero.
+    assert CostAttributor().conservation()["ratio"] == 1.0
+
+
+def test_window_groups_by_tenant_and_task():
+    a = CostAttributor()
+    for tid, task, tenant, verdict in (
+            ("a", "vqa", "acme", "ok"), ("b", "vqa", "acme", "ok"),
+            ("c", "retrieval", "zed", "dead_letter")):
+        a.begin(tid, task=task, tenant=tenant)
+        a.charge(tid, "intake", 0.001)
+        a.finish(tid, verdict)
+    by_tenant = a.window(by="tenant")
+    assert by_tenant["by"] == "tenant"
+    assert by_tenant["groups"]["acme"]["jobs"] == 2
+    assert by_tenant["groups"]["zed"]["verdicts"] == {"dead_letter": 1}
+    by_task = a.window(by="task")
+    assert by_task["groups"]["vqa"]["stage_ms"]["intake"] == 2.0
+    assert "conservation" in by_task
+    # A window in the future excludes everything already finished.
+    assert a.window(window_s=-60.0)["groups"] == {}
+
+
+def test_open_records_bounded_oldest_evicted():
+    a = CostAttributor(max_open=2)
+    a.begin("t1")
+    a.begin("t2")
+    a.begin("t3")  # evicts t1
+    assert a.get("t1") is None
+    assert a.get("t2") is not None and a.get("t3") is not None
+
+
+def test_on_finish_hook_errors_never_break_finish():
+    def boom(cost):
+        raise RuntimeError("store down")
+    a = CostAttributor(on_finish=boom)
+    a.begin("t1", task="vqa")
+    assert a.finish("t1", "ok") is not None
+    assert a.finished == 1
+
+
+# ------------------------------------------------------- module-level plane
+def test_module_plane_routes_to_installed_attributor():
+    a = CostAttributor()
+    set_attributor(a)
+    try:
+        assert get_attributor() is a
+        job_begin("t1", job_id=1, task="vqa", tenant="acme")
+        job_charge("t1", "intake", 0.002)
+        job_batch(1.0, [("t1", 2)], batch_rows=2, bucket=2)
+        job_finish("t1", "ok")
+    finally:
+        set_attributor(None)
+    (cost,) = a.completed()
+    assert cost.stages["intake"] == 2.0 and cost.device_s == 1.0
+
+
+def test_attrib_disabled_mode_overhead_under_5us():
+    """The job_* helpers are a single None-check when attribution is off —
+    same tier-1 guard as the tracer/recorder disabled modes."""
+    set_attributor(None)
+    n = 10_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            job_begin("t", task="vqa")
+            job_charge("t", "intake", 0.001)
+            job_finish("t", "ok")
+        best = min(best, (time.perf_counter() - t0) / (3 * n))
+    assert best < 5e-6, f"disabled job_* call costs {best * 1e6:.2f} us"
+
+
+# ------------------------------------------------------------- trace store
+class _Rng:
+    """Deterministic sampler: pops scripted values."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+    def random(self):
+        return self.values.pop(0)
+
+
+def _cost(trace_id, *, task="vqa", tenant="acme", verdict="ok", ms=10.0):
+    c = JobCost(trace_id=trace_id, task=task, tenant=tenant,
+                verdict=verdict)
+    c.stages["forward"] = ms
+    c.finished_unix = time.time()
+    return c
+
+
+def test_keep_policy_verdict_pinned_topk_sampled(tmp_path):
+    store = TraceStore(str(tmp_path / "spine.db"), "w0", keep_top_k=1,
+                       sample_rate=0.5, rng=_Rng([0.9, 0.1]))
+    # 1) non-ok verdicts always keep
+    assert store.offer(_cost("t-dead", verdict="dead_letter")) == "verdict"
+    # 2) pinned SLO offenders keep even when the sampler would drop them
+    store.pin(["t-pin"])
+    assert store.offer(_cost("t-pin", ms=1.0)) == "pinned"
+    # 3) first ok completion seeds the per-task top-K
+    assert store.offer(_cost("t-slow", ms=50.0)) == "slow"
+    # 4) faster than the slot floor + rng 0.9 >= 0.5 -> dropped
+    assert store.offer(_cost("t-fast", ms=2.0)) is None
+    # 5) faster + rng 0.1 < 0.5 -> p-sampled
+    assert store.offer(_cost("t-luck", ms=2.0)) == "sampled"
+    # 6) slower than the floor displaces the top-K slot
+    assert store.offer(_cost("t-slower", ms=80.0)) == "slow"
+    assert store.stats()["offered"] == 6 and store.stats()["kept"] == 5
+    assert store.stats()["tail_kept_frac"] == round(5 / 6, 4)
+
+
+def test_flush_persists_retention_trims_and_survives_reopen(tmp_path):
+    path = str(tmp_path / "spine.db")
+    store = TraceStore(path, "w0", retention_s=3600.0)
+    tr = Tracer()
+    with tr.span("forward"):
+        pass
+    (span,) = tr.spans()
+    cost = _cost(span.trace_id, verdict="dead_letter", ms=25.0)
+    assert store.offer(cost, tr.spans()) == "verdict"
+    assert store.stats()["pending"] == 1
+    assert store.flush() == 1
+    assert store.stats()["pending"] == 0
+
+    row = store.get(span.trace_id)
+    assert row["verdict"] == "dead_letter" and row["ident"] == "w0"
+    assert row["cost"]["total_ms"] == 25.0
+    assert [s["name"] for s in row["spans"]] == ["forward"]
+
+    # Durable across process restarts: a fresh handle reads the row.
+    reopened = TraceStore(path, "w1")
+    assert reopened.get(span.trace_id)["ident"] == "w0"
+
+    # Retention: a zero-retention flush trims everything already stored.
+    expiring = TraceStore(path, "w1", retention_s=0.0)
+    expiring.flush()
+    assert store.get(span.trace_id) is None
+
+
+def test_offer_filters_spans_to_the_job_trace(tmp_path):
+    store = TraceStore(str(tmp_path / "spine.db"), "w0")
+    tr = Tracer()
+    with tr.span("mine"):
+        pass
+    with tr.span("other-jobs"):
+        pass
+    mine, other = tr.spans()
+    store.offer(_cost(mine.trace_id, verdict="deadline"), tr.spans())
+    store.flush()
+    row = store.get(mine.trace_id)
+    assert [s["name"] for s in row["spans"]] == ["mine"]
+    assert other.trace_id != mine.trace_id
+
+
+def test_list_scope_local_vs_fleet_and_filters(tmp_path):
+    path = str(tmp_path / "spine.db")
+    w0 = TraceStore(path, "w0")
+    w1 = TraceStore(path, "w1")
+    w0.offer(_cost("t-w0", task="vqa", verdict="dead_letter"))
+    w1.offer(_cost("t-w1", task="retrieval", tenant="zed",
+                   verdict="deadline"))
+    w0.flush()
+    w1.flush()
+    # Fleet scope reads every ident on disk (dead peers included — the
+    # span-retention contract); local restricts to this process.
+    assert {r["ident"] for r in w0.list(scope="fleet")} == {"w0", "w1"}
+    assert {r["ident"] for r in w0.list(scope="local")} == {"w0"}
+    assert [r["trace_id"] for r in w0.list(task="retrieval")] == ["t-w1"]
+    assert [r["trace_id"] for r in w0.list(tenant="zed")] == ["t-w1"]
+    assert [r["trace_id"]
+            for r in w0.list(verdict="dead_letter")] == ["t-w0"]
+
+
+def test_list_verdict_slow_matches_keep_reason(tmp_path):
+    store = TraceStore(str(tmp_path / "spine.db"), "w0", keep_top_k=1)
+    assert store.offer(_cost("t-slow", ms=90.0)) == "slow"
+    store.flush()
+    (row,) = store.list(verdict="slow")
+    assert row["trace_id"] == "t-slow"
+    assert row["keep_reason"] == "slow" and row["verdict"] == "ok"
+
+
+# ---------------------------------------------------- exemplars + openmetrics
+def test_histogram_exemplars_newest_wins_and_slowest():
+    reg = Registry()
+    hist = reg.histogram("lat_ms", "latency", ("task",),
+                         buckets=(10.0, 100.0))
+    hist.observe(5.0, exemplar_trace_id="aaa", task="vqa")
+    hist.observe(7.0, exemplar_trace_id="bbb", task="vqa")  # same bucket
+    hist.observe(50.0, exemplar_trace_id="ccc", task="vqa")
+    hist.observe(3.0, task="vqa")  # exemplar-less: slot untouched
+    ex = hist.collect_exemplars()[("vqa",)]
+    assert ex[0][:2] == (7.0, "bbb")  # newest wins within the bucket
+    assert ex[1][:2] == (50.0, "ccc")
+    assert hist.slowest_exemplars(2) == [(50.0, "ccc"), (7.0, "bbb")]
+
+
+def test_openmetrics_golden():
+    reg = Registry()
+    c = reg.counter("vmt_jobs_total", "Jobs.", ("task",))
+    c.inc(3, task="vqa")
+    hist = reg.histogram("lat_ms", "latency", ("task",), buckets=(10.0,))
+    hist.observe(5.0, exemplar_trace_id="abc123", task="vqa")
+    text = render_openmetrics(reg)
+    lines = text.splitlines()
+    # Counter family drops _total; the sample line keeps it.
+    assert "# TYPE vmt_jobs counter" in lines
+    assert 'vmt_jobs_total{task="vqa"} 3' in lines
+    # Bucket line carries its exemplar: # {trace_id="..."} value ts
+    (bucket_line,) = [l for l in lines if l.startswith("lat_ms_bucket")
+                      and 'le="10"' in l]
+    assert '# {trace_id="abc123"} 5' in bucket_line
+    assert 'lat_ms_sum{task="vqa"} 5' in lines
+    assert 'lat_ms_count{task="vqa"} 1' in lines
+    # Spec terminator + the content type the handler advertises.
+    assert text.endswith("# EOF\n")
+    assert "openmetrics-text" in OPENMETRICS_CONTENT_TYPE
+
+
+def test_openmetrics_histogram_without_exemplar_has_plain_buckets():
+    reg = Registry()
+    hist = reg.histogram("lat_ms", "latency", buckets=(10.0,))
+    hist.observe(5.0)
+    text = render_openmetrics(reg)
+    bucket_lines = [l for l in text.splitlines()
+                    if l.startswith("lat_ms_bucket")]
+    assert bucket_lines and all("#" not in l for l in bucket_lines)
+
+
+def test_job_cost_as_dict_round_trips_json():
+    cost = _cost("t1", ms=12.5)
+    doc = json.loads(json.dumps(cost.as_dict()))
+    assert doc["trace_id"] == "t1" and doc["total_ms"] == 12.5
+    assert doc["stages"] == {"forward": 12.5}

@@ -34,6 +34,7 @@ from vilbert_multitask_tpu.obs.ledger import (
 )
 from vilbert_multitask_tpu.obs.timeseries import TimeSeriesStore
 from vilbert_multitask_tpu.obs.trace import Tracer
+from vilbert_multitask_tpu.obs.tracestore import TraceStore
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRACE_ID = "feedface00000000"
@@ -174,6 +175,17 @@ with tr.trace("feedface00000000"):
 me = mint_identity(role="peer")
 spine = FleetSpine(db, me, registry=reg, tracer=tr)
 spine.flush({"phase": "ready"})
+# A tail-kept trace on the same spine db: the crash-autopsy subject the
+# SIGKILL test reads back after this process is dead and evicted.
+from vilbert_multitask_tpu.obs.attrib import JobCost
+from vilbert_multitask_tpu.obs.tracestore import TraceStore
+store = TraceStore(db, me.ident)
+cost = JobCost(trace_id="feedface00000000", task="vqa", tenant="acme",
+               verdict="ok")
+cost.stages["forward"] = 250.0
+cost.finished_unix = time.time()
+store.offer(cost, tr.spans())
+store.flush()
 print("IDENT " + me.ident, flush=True)
 if mode == "linger":
     time.sleep(120)
@@ -258,6 +270,26 @@ def test_sigkilled_peer_evicted_after_heartbeat_staleness(tmp_path):
         # Evicted from the merged exposition: only the live counter shows.
         assert "vmt_fleet_test_total 3" in spine.render_prometheus()
         assert peer_ident not in spine.live_idents()
+
+        # Span-retention asymmetry: eviction withdraws the peer from the
+        # health/metrics merges ONLY. Its spans still stitch into the
+        # fleet timeline, and its tail-kept trace is still readable from
+        # the survivor — the crash autopsy the store exists for.
+        events = [e for e in spine.chrome_trace(TRACE_ID)["traceEvents"]
+                  if e.get("ph") == "X"]
+        assert "peer.work" in {e["name"] for e in events}
+        assert peer_ident in {e["args"]["ident"] for e in events}
+        survivor = TraceStore(db, spine.identity.ident)
+        rows = survivor.list(verdict="slow", task="vqa", scope="fleet")
+        assert TRACE_ID in {r["trace_id"] for r in rows}
+        stored = survivor.get(TRACE_ID)
+        assert stored["ident"] == peer_ident
+        assert stored["cost"]["total_ms"] == 250.0
+        assert "peer.work" in {s["name"] for s in stored["spans"]}
+        # scope=local on the survivor excludes the dead peer's rows —
+        # the asymmetry is an explicit choice, not a missed filter.
+        assert TRACE_ID not in {
+            r["trace_id"] for r in survivor.list(scope="local")}
     finally:
         proc.kill()
         proc.wait()

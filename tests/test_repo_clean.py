@@ -72,7 +72,8 @@ def test_debug_surface_is_wired():
     api_src = open(os.path.join(
         REPO_ROOT, "vilbert_multitask_tpu", "serve", "http_api.py")).read()
     for route in ("/healthz", "/metrics", "/debug/slo", "/debug/timeseries",
-                  "/debug/trace"):
+                  "/debug/trace", "/debug/costs", "/debug/traces",
+                  "/debug/autopsy"):
         assert f'"{route}"' in api_src, f"route {route} left the http api"
 
 
@@ -93,7 +94,7 @@ def test_whole_program_rules_active_and_scan_covers_tests():
             "VMT119", "VMT120", "VMT121", "VMT122", "VMT123",
             "VMT124", "VMT125", "VMT126", "VMT127",
             "VMT128", "VMT129", "VMT130", "VMT131",
-            "VMT132", "VMT133", "VMT134", "VMT135"} <= ids
+            "VMT132", "VMT133", "VMT134", "VMT135", "VMT136"} <= ids
     assert cfg.layers, "[tool.vmtlint.layers] contracts disappeared"
     assert any(p == "tests" or p.startswith("tests/") for p in cfg.paths)
 

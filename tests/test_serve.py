@@ -993,6 +993,71 @@ def test_debug_timeseries_serves_sampled_window(stack):
         api.stop()
 
 
+def test_cost_attribution_end_to_end(stack, tmp_path):
+    """One HTTP-submitted job rides the whole attribution plane: stage
+    charges land on its JobCost, /debug/costs groups by tenant, the
+    trace store keeps it, /debug/autopsy waterfalls it, and the
+    OpenMetrics exposition links the latency bucket to its trace id."""
+    from vilbert_multitask_tpu import obs
+
+    s, hub, q, store, worker = stack
+    tracestore = obs.TraceStore(str(tmp_path / "spine.db"), "test-ident")
+    attrib = obs.CostAttributor(
+        on_finish=lambda cost: tracestore.offer(
+            cost, obs.default_tracer().spans()))
+    api = ApiServer(q, store, hub, s, metrics=worker.metrics,
+                    attrib=attrib, tracestore=tracestore)
+    port = api.start()
+    obs.set_attributor(attrib)
+    obs.default_tracer().clear()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("POST", "/", body=json.dumps({
+            "task_id": 1, "socket_id": "sockC", "question": "what is this",
+            "image_list": ["img_a.jpg"], "tenant": "acme",
+        }), headers={"Content-Type": "application/json"})
+        trace_id = json.loads(conn.getresponse().read())["trace_id"]
+
+        assert worker.step_batch() == 1  # claim → forward → push
+
+        cost = attrib.get(trace_id)
+        assert cost is not None and cost.verdict == "ok"
+        assert cost.tenant == "acme" and cost.task == "1"
+        assert cost.device_s > 0 and cost.stages["forward"] > 0
+        for stage in ("queue_wait", "intake", "decode", "push"):
+            assert stage in cost.stages, f"stage {stage} never charged"
+        assert attrib.conservation()["ratio"] == 1.0
+
+        conn.request("GET", "/debug/costs?by=tenant")
+        costs = json.loads(conn.getresponse().read())
+        assert costs["enabled"] is True
+        assert costs["groups"]["acme"]["jobs"] == 1
+        assert costs["groups"]["acme"]["verdicts"] == {"ok": 1}
+
+        conn.request("GET", "/debug/traces?verdict=slow&task=1")
+        traces = json.loads(conn.getresponse().read())
+        assert trace_id in {t["trace_id"] for t in traces["traces"]}
+        assert traces["stats"]["kept"] == 1
+
+        conn.request("GET", f"/debug/autopsy?trace_id={trace_id}")
+        autopsy = json.loads(conn.getresponse().read())
+        assert autopsy["verdict"] == "ok"
+        waterfall = {w["stage"]: w["ms"] for w in autopsy["waterfall"]}
+        assert waterfall["forward"] > 0
+        assert autopsy["total_ms"] == pytest.approx(
+            sum(waterfall.values()), abs=0.01)
+
+        conn.request("GET", "/metrics?format=openmetrics")
+        resp = conn.getresponse()
+        assert "openmetrics-text" in resp.getheader("Content-Type")
+        text = resp.read().decode()
+        assert text.endswith("# EOF\n")
+        assert f'# {{trace_id="{trace_id}"}}' in text
+    finally:
+        obs.set_attributor(None)
+        api.stop()
+
+
 def test_serveapp_start_exposes_build_info_uptime_and_recorder(
         tiny_framework_cfg, features_dir, tmp_path):
     """ServeApp.start() must publish vmt_build_info + vmt_uptime_seconds,

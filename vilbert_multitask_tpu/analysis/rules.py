@@ -2062,6 +2062,95 @@ def _instrument_audit(project) -> Dict:
     return audit
 
 
+# --------------------------------------------------------------------- 136
+class ExemplarCardinality(Rule):
+    """``observe(..., exemplar_trace_id=...)`` alongside an unbounded-
+    origin label value. Exemplars live per label series (one slot per
+    bucket per labelset, each holding a value + trace id + timestamp) —
+    a label fed from request data or an unconstrained parameter mints a
+    new series per distinct value, so the exemplar map grows without
+    bound exactly where tail-sampling was supposed to bound retention.
+    Label values routed through bucketizers/config knobs/literals are
+    bounded and clean — the VMT124 origin lattice, applied to the
+    metrics→trace link instead of the compile cache.
+    """
+
+    id = "VMT136"
+    name = "exemplar-cardinality"
+    severity = "error"
+    description = ("histogram observe() attaching an exemplar while a "
+                   "label value is request/caller-derived — an unbounded "
+                   "label universe turns the per-series exemplar slots "
+                   "into an unbounded map")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        from vilbert_multitask_tpu.analysis.shaperules import (
+            _module_functions,
+            _own_scope,
+            _project_knobs,
+        )
+        from vilbert_multitask_tpu.analysis.shapes import (
+            Scalar,
+            call_nodes_in,
+            flows_from,
+            interpret_function,
+        )
+
+        knobs = None
+        seen: Set[Tuple[int, str]] = set()
+        for fn in _module_functions(ctx):
+            targets = {
+                id(n) for n in _own_scope(fn)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "observe"
+                and any(kw.arg == "exemplar_trace_id"
+                        for kw in n.keywords)
+            }
+            if not targets:
+                continue
+            if knobs is None:
+                knobs = _project_knobs(ctx)
+            interp = interpret_function(ctx, fn, knobs)
+            for event, fact in interp.iter_facts():
+                for call in call_nodes_in(event):
+                    if id(call) not in targets:
+                        continue
+                    for kw in call.keywords:
+                        if kw.arg in (None, "exemplar_trace_id"):
+                            continue
+                        key = (id(call), kw.arg)
+                        if key in seen:
+                            continue
+                        val = interp.eval(kw.value, fact)
+                        if not (isinstance(val, Scalar)
+                                and val.origin in ("param", "data")):
+                            continue
+                        seen.add(key)
+                        f = self.finding(
+                            ctx, call,
+                            f"label `{kw.arg}` on an exemplar-carrying "
+                            f"observe() is {_EX_ORIGIN_DESC[val.origin]} "
+                            f"— every distinct value mints a label "
+                            f"series with its own exemplar slot; route "
+                            f"it through a bounded vocabulary (task "
+                            f"registry, config knob, bucketizer) before "
+                            f"labelling")
+                        f.flows = flows_from(
+                            val.witness,
+                            (ctx.rel_path, call.lineno,
+                             f"flows into label `{kw.arg}` of an "
+                             f"exemplar-carrying observe() — a new "
+                             f"value here is a new exemplar series"))
+                        yield f
+
+
+_EX_ORIGIN_DESC = {
+    "param": "caller-controlled (an unconstrained parameter)",
+    "data": "derived from request data (e.g. a payload field)",
+}
+
+
 from vilbert_multitask_tpu.analysis.locks import (  # noqa: E402
     JitClosureCapture, LockOrderInversion, WaitHoldingForeignLock)
 from vilbert_multitask_tpu.analysis.shaperules import (  # noqa: E402
@@ -2084,7 +2173,8 @@ RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          UnboundedCompileKey, DtypePromotionLeak, PartitionRankMismatch,
          BucketShapeDrift, RmwDeferredTxn, MultiWriteNoTxn, SqlSchemaDrift,
          NondeterministicClaim, JobTerminalProtocol,
-         ResourceLeakOnException, FaultPointCoverage, TerminalFrameDrift]
+         ResourceLeakOnException, FaultPointCoverage, TerminalFrameDrift,
+         ExemplarCardinality]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

@@ -551,6 +551,17 @@ class ServingConfig:
     fleet_max_spans: int = 2048
     fleet_spans_per_flush: int = 256
     fleet_timeseries_window_s: float = 600.0
+    # Cost attribution + durable trace store (obs/attrib.py,
+    # obs/tracestore.py): per-job stage/device-second accounting and
+    # tail-sampled trace persistence on the fleet spine db. The keep
+    # policy is verdict-based — non-ok terminals always persist, the
+    # top-K slowest completions per task persist, the rest are
+    # p-sampled — and rows older than the retention window are trimmed
+    # on each flush.
+    attrib_enabled: bool = True
+    tracestore_keep_top_k: int = 8
+    tracestore_sample_rate: float = 0.05
+    tracestore_retention_s: float = 3600.0
 
 
 @dataclasses.dataclass(frozen=True)

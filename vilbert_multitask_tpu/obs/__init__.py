@@ -38,13 +38,27 @@ from vilbert_multitask_tpu.obs.instruments import (
     percentile,
 )
 from vilbert_multitask_tpu.obs.export import (
+    OPENMETRICS_CONTENT_TYPE,
     PROMETHEUS_CONTENT_TYPE,
     chrome_trace,
     dump_trace,
+    render_openmetrics,
     render_prometheus,
     start_profile,
     stop_profile,
 )
+from vilbert_multitask_tpu.obs.attrib import (
+    STAGES as COST_STAGES,
+    CostAttributor,
+    JobCost,
+    get_attributor,
+    job_batch,
+    job_begin,
+    job_charge,
+    job_finish,
+    set_attributor,
+)
+from vilbert_multitask_tpu.obs.tracestore import TraceStore
 from vilbert_multitask_tpu.obs.timeseries import (
     SAMPLER_THREAD_NAME,
     Sampler,
@@ -91,8 +105,12 @@ __all__ = [
     "span", "trace_scope",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "log_buckets", "percentile",
-    "PROMETHEUS_CONTENT_TYPE", "chrome_trace", "dump_trace",
-    "render_prometheus", "start_profile", "stop_profile",
+    "OPENMETRICS_CONTENT_TYPE", "PROMETHEUS_CONTENT_TYPE", "chrome_trace",
+    "dump_trace", "render_openmetrics", "render_prometheus",
+    "start_profile", "stop_profile",
+    "COST_STAGES", "CostAttributor", "JobCost", "TraceStore",
+    "get_attributor", "job_batch", "job_begin", "job_charge", "job_finish",
+    "set_attributor",
     "SHED_COUNTER", "RETRY_COUNTER", "BREAKER_GAUGE", "DEADLINE_SLACK",
     "BATCH_FILL", "SCHED_WAIT", "QUEUE_WAIT", "BATCHES_DISPATCHED",
     "REPLICA_STATE", "FAILOVER_COUNTER", "POISON_COUNTER",

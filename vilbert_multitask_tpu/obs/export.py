@@ -112,6 +112,63 @@ def render_prometheus(registry: Optional[Registry] = None,
     return "\n".join(lines) + "\n"
 
 
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def render_openmetrics(registry: Optional[Registry] = None,
+                       extra: Sequence = ()) -> str:
+    """The registry in OpenMetrics text format, exemplars included.
+
+    Differences from :func:`render_prometheus` that matter here: the
+    metric *family* name drops a counter's ``_total`` suffix (the sample
+    line keeps it), histogram bucket lines carry their bucket's exemplar
+    as ``# {trace_id="..."} value timestamp`` — the metrics→trace link
+    Grafana/Prometheus follow straight to a stored autopsy — and the
+    exposition ends with ``# EOF``. ``GET /metrics?format=openmetrics``
+    serves it.
+    """
+    registry = registry if registry is not None else REGISTRY
+    defaults = list(registry.default_labels().items())
+    lines: List[str] = []
+    for inst in sorted(registry.instruments() + list(extra),
+                       key=lambda i: i.name):
+        name = _metric_name(inst.name)
+        family = (name[: -len("_total")]
+                  if inst.kind == "counter" and name.endswith("_total")
+                  else name)
+        base = [(k, v) for k, v in defaults if k not in inst.labelnames]
+        if inst.help:
+            lines.append(f"# HELP {family} {_escape_help(inst.help)}")
+        lines.append(f"# TYPE {family} {inst.kind}")
+        if isinstance(inst, Histogram):
+            exemplars = inst.collect_exemplars()
+            for key, series in sorted(inst.collect().items()):
+                key_ex = exemplars.get(key, {})
+                for i, (bound, cumulative) in enumerate(series["buckets"]):
+                    line = (f"{name}_bucket"
+                            f"{_labels(inst.labelnames, key, [('le', _fmt(bound))] + base)}"
+                            f" {cumulative}")
+                    ex = key_ex.get(i)
+                    if ex is not None:
+                        value, trace_id, ts = ex
+                        line += (f' # {{trace_id="{_escape_label(trace_id)}"}}'
+                                 f" {_fmt(value)} {ts:.3f}")
+                    lines.append(line)
+                lines.append(f"{name}_sum{_labels(inst.labelnames, key, base)} "
+                             f"{_fmt(series['sum'])}")
+                lines.append(f"{name}_count{_labels(inst.labelnames, key, base)} "
+                             f"{series['count']}")
+        else:
+            suffix = "_total" if inst.kind == "counter" else ""
+            for key, value in sorted(inst.collect().items()):
+                lines.append(f"{family}{suffix}"
+                             f"{_labels(inst.labelnames, key, base)} "
+                             f"{_fmt(value)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
 # ----------------------------------------------------------- chrome trace
 def chrome_trace(spans: Optional[Sequence[Span]] = None,
                  tracer: Optional[Tracer] = None,

@@ -396,6 +396,13 @@ class FleetSpine:
         (named ``role ident``); timestamps are µs relative to the
         earliest span so the submitter's ``http.submit`` and the
         worker's ``worker.job`` line up on one axis.
+
+        Deliberately liveness-blind, unlike the metrics/health merges:
+        staleness eviction (and ``retire()``) withdraws a peer's
+        *presence*, never its spans — a SIGKILL'd worker's half of a
+        trace is exactly the autopsy this view exists for, so span
+        reads include every ident still on disk. The trace store
+        (obs/tracestore.py) reads under the same contract.
         """
         with self._conn() as c:
             if trace_id:

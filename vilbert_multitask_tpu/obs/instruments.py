@@ -128,7 +128,8 @@ class _HistSeries:
     """One label set's state: bucket counts + count/sum + raw reservoir +
     a timestamped window ring for sliding-window aggregation."""
 
-    __slots__ = ("counts", "count", "sum", "reservoir", "window")
+    __slots__ = ("counts", "count", "sum", "reservoir", "window",
+                 "exemplars")
 
     def __init__(self, n_buckets: int, reservoir: int):
         self.counts = [0] * (n_buckets + 1)  # +1: the implicit +Inf bucket
@@ -138,6 +139,9 @@ class _HistSeries:
         # (t, value) pairs, same bound as the reservoir: the window is a
         # VIEW of recent samples, never an unbounded log.
         self.window: deque = deque(maxlen=reservoir)
+        # bucket index -> (value, trace_id, unix_ts): the newest exemplar
+        # per bucket — bounded by the bucket count, the OpenMetrics shape.
+        self.exemplars: Dict[int, Tuple[float, str, float]] = {}
 
 
 class Histogram(_Instrument):
@@ -168,7 +172,8 @@ class Histogram(_Instrument):
                 len(self.buckets), self._reservoir)
         return series
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, *,
+                exemplar_trace_id: Optional[str] = None, **labels) -> None:
         key = self._key(labels)
         value = float(value)
         i = bisect.bisect_left(self.buckets, value)
@@ -180,6 +185,13 @@ class Histogram(_Instrument):
             series.sum += value
             series.reservoir.append(value)
             series.window.append((now, value))
+            if exemplar_trace_id:
+                # Newest-wins per bucket: an exemplar is a SAMPLE linking
+                # the bucket to one concrete trace, not a log. The stamp
+                # is wall-clock because OpenMetrics exemplar timestamps
+                # are unix epoch (a stamp, not a duration).
+                series.exemplars[i] = (
+                    value, str(exemplar_trace_id), time.time())
 
     # ----------------------------------------------------------- inspection
     def samples(self, **labels) -> List[float]:
@@ -262,6 +274,24 @@ class Histogram(_Instrument):
                 out[key] = {"buckets": cumulative, "count": series.count,
                             "sum": series.sum}
         return out
+
+    def collect_exemplars(self) -> Dict[Tuple[str, ...],
+                                        Dict[int, Tuple[float, str, float]]]:
+        """Per-label-set {bucket index: (value, trace_id, unix_ts)} — the
+        OpenMetrics renderer attaches these to the matching bucket lines."""
+        with self._lock:
+            return {key: dict(series.exemplars)
+                    for key, series in self._series.items()
+                    if series.exemplars}
+
+    def slowest_exemplars(self, n: int = 3) -> List[Tuple[float, str]]:
+        """The ``n`` largest exemplar-bearing observations across every
+        label set, ``(value, trace_id)`` descending — the SLO page's
+        "top offending traces" link to stored autopsies."""
+        with self._lock:
+            pairs = [(v, tid) for s in self._series.values()
+                     for v, tid, _ts in s.exemplars.values()]
+        return sorted(pairs, key=lambda p: p[0], reverse=True)[:max(n, 0)]
 
 
 class Registry:

@@ -42,7 +42,9 @@ class Slo:
 
     def __init__(self, name: str, objective: str,
                  counts_fn: Callable[[float], Tuple[int, int]],
-                 error_budget: float = 0.01):
+                 error_budget: float = 0.01,
+                 exemplars_fn: Optional[
+                     Callable[[], List[Tuple[float, str]]]] = None):
         if not 0.0 < error_budget < 1.0:
             raise ValueError(f"slo {name!r}: error_budget must be in (0,1), "
                              f"got {error_budget}")
@@ -50,6 +52,19 @@ class Slo:
         self.objective = objective
         self.error_budget = float(error_budget)
         self._counts_fn = counts_fn
+        # Optional metrics→trace link: (value, trace_id) pairs for the
+        # worst recent observations of the histogram feeding this SLO.
+        # Page payloads embed them so a burn links straight to stored
+        # autopsies (/debug/autopsy?trace_id=).
+        self._exemplars_fn = exemplars_fn
+
+    def exemplar_trace_ids(self) -> List[str]:
+        if self._exemplars_fn is None:
+            return []
+        try:
+            return [tid for _v, tid in self._exemplars_fn() if tid]
+        except Exception:  # an exemplar probe must never fail evaluation
+            return []
 
     def burn_rate(self, window_s: float) -> Tuple[float, int, int]:
         """(burn, good, bad) over the window; an empty window burns 0 —
@@ -71,7 +86,8 @@ def latency_slo(name: str, hist: Histogram, target_ms: float,
         bad = sum(1 for v in xs if v > target_ms)
         return len(xs) - bad, bad
     return Slo(name, f"latency <= {target_ms:g} ms", counts,
-               error_budget=error_budget)
+               error_budget=error_budget,
+               exemplars_fn=lambda: hist.slowest_exemplars(3))
 
 
 def slack_floor_slo(name: str, hist: Histogram, floor_ms: float,
@@ -123,7 +139,8 @@ class SloEvaluator:
     def _page_event(slo_name: str, report: dict) -> None:
         record_event("slo_page", slo=slo_name,
                      burn_fast=report["burn"]["fast"],
-                     burn_slow=report["burn"]["slow"])
+                     burn_slow=report["burn"]["slow"],
+                     exemplar_trace_ids=report.get("exemplar_trace_ids", []))
 
     def evaluate(self) -> List[dict]:
         """Evaluate every SLO now; publishes gauges, fires the PAGE
@@ -150,6 +167,9 @@ class SloEvaluator:
                                   "slow": self.slow_window_s},
                     "events": {"fast": {"good": fg, "bad": fb},
                                "slow": {"good": sg, "bad": sb}},
+                    # Top offending traces (newest slowest exemplars) —
+                    # each resolves via /debug/autopsy?trace_id=.
+                    "exemplar_trace_ids": slo.exemplar_trace_ids(),
                 }
                 SLO_STATE_GAUGE.set(_STATE_CODES[state], slo=slo.name)
                 SLO_BURN_GAUGE.set(round(fast, 4), slo=slo.name,

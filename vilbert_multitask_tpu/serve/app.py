@@ -30,6 +30,9 @@ from vilbert_multitask_tpu.serve.worker import ServeWorker
 _FLEET_FLUSH_ERRORS = obs.REGISTRY.counter(
     "vmt_fleet_flush_errors_total",
     "Sampler ticks whose fleet-spine flush failed (local tick unaffected).")
+_TRACESTORE_FLUSH_ERRORS = obs.REGISTRY.counter(
+    "vmt_tracestore_flush_errors_total",
+    "Sampler ticks whose trace-store flush failed (local tick unaffected).")
 
 
 class ServeApp:
@@ -191,6 +194,10 @@ class ServeApp:
         # SLO evaluator, and the flight recorder. Built here so /debug/slo
         # and /healthz see them from the first request; the sampler thread
         # and the recorder's global installation happen in start().
+        # Placeholders so _build_slos's page hook can close over them; the
+        # real instances are built after the fleet spine (shared db path).
+        self.attrib: Optional[obs.CostAttributor] = None
+        self.tracestore: Optional[obs.TraceStore] = None
         self.timeseries = obs.TimeSeriesStore(points=s.timeseries_points)
         self.slos = self._build_slos()
         self.sampler = obs.Sampler(self.timeseries, self._sample,
@@ -211,6 +218,18 @@ class ServeApp:
                 spans_per_flush=s.fleet_spans_per_flush,
                 timeseries_window_s=s.fleet_timeseries_window_s,
                 timeseries=self.timeseries)
+        # Cost-attribution plane: per-job stage/device-second records
+        # (obs/attrib.py) feeding the durable tail-sampled trace store
+        # (obs/tracestore.py) on the SAME sqlite file as the fleet spine —
+        # one db to mount, and ?scope=fleet trace reads come for free.
+        if s.attrib_enabled:
+            self.tracestore = obs.TraceStore(
+                s.fleet_db_path or obs.default_spine_path(s.queue_db_path),
+                self.identity.ident,
+                keep_top_k=s.tracestore_keep_top_k,
+                sample_rate=s.tracestore_sample_rate,
+                retention_s=s.tracestore_retention_s)
+            self.attrib = obs.CostAttributor(on_finish=self._offer_trace)
         rec_dir = s.recorder_dir
         if rec_dir == "serve_state/postmortem":
             # Default follows the queue db (tests and the soak point that
@@ -235,7 +254,8 @@ class ServeApp:
             metrics=self.worker.metrics, boot_info=self.boot_info,
             stats_fn=lambda: {"input_cache": self.engine.input_cache_stats},
             slos=self.slos, timeseries=self.timeseries,
-            pool=self.engine, swap_fn=self.rolling_swap, fleet=self.fleet)
+            pool=self.engine, swap_fn=self.rolling_swap, fleet=self.fleet,
+            attrib=self.attrib, tracestore=self.tracestore)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
@@ -287,10 +307,27 @@ class ServeApp:
                 f"replica_{rep.name}_availability",
                 f"dispatches on replica {rep.name} succeed", counts,
                 error_budget=s.slo_availability_budget))
+        def on_page(name: str, report: dict) -> None:
+            # Default recorder trigger, plus: the page's exemplar traces
+            # get pinned so the store force-keeps their next offers even
+            # when the tail sampler would have dropped them.
+            obs.SloEvaluator._page_event(name, report)
+            if self.tracestore is not None:
+                self.tracestore.pin(report.get("exemplar_trace_ids", []))
         return obs.SloEvaluator(
             slos, fast_window_s=s.slo_fast_window_s,
             slow_window_s=s.slo_slow_window_s,
-            warn_burn=s.slo_warn_burn, page_burn=s.slo_page_burn)
+            warn_burn=s.slo_warn_burn, page_burn=s.slo_page_burn,
+            on_page=on_page)
+
+    def _offer_trace(self, cost: "obs.JobCost") -> None:
+        """Attributor → store handoff (runs on the finishing worker
+        thread, outside the attributor lock): the completed cost record
+        plus its spans still in the local tracer ring."""
+        store = self.tracestore
+        if store is None:
+            return
+        store.offer(cost, obs.default_tracer().spans())
 
     def _sample(self) -> dict:
         """One sampler tick's worth of live signals. ``*_total`` keys get
@@ -325,6 +362,12 @@ class ServeApp:
                                   "slo_worst": worst})
             except Exception:  # noqa: BLE001
                 _FLEET_FLUSH_ERRORS.inc()
+        # Trace-store flush rides the same tick, isolated the same way.
+        if self.tracestore is not None:
+            try:
+                self.tracestore.flush()
+            except Exception:  # noqa: BLE001
+                _TRACESTORE_FLUSH_ERRORS.inc()
         return vals
 
     def warm(self) -> None:
@@ -415,6 +458,10 @@ class ServeApp:
             instance=self.identity.ident, role=self.identity.role)
         # The flight recorder goes live before any tier can trip it.
         obs.install_recorder(self.recorder)
+        # Same discipline for cost attribution: the module-plane helper
+        # sites in worker/scheduler become live before the first claim.
+        if self.attrib is not None:
+            obs.set_attributor(self.attrib)
         # Websocket first: /config must never advertise an unbound ws port
         # (the browser caches it and would reconnect to ws://host:0 forever).
         self.ws.start()
@@ -468,6 +515,16 @@ class ServeApp:
                 self.fleet.retire()
             except Exception:  # noqa: BLE001 — teardown is best-effort
                 _FLEET_FLUSH_ERRORS.inc()
+        # Final trace-store flush (keeps buffered since the last tick must
+        # survive the shutdown), then detach the module-plane attributor —
+        # but only OUR OWN installation, like the recorder below.
+        if self.tracestore is not None:
+            try:
+                self.tracestore.flush()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                _TRACESTORE_FLUSH_ERRORS.inc()
+        if self.attrib is not None and obs.get_attributor() is self.attrib:
+            obs.set_attributor(None)
         obs.REGISTRY.set_default_labels()
         obs.default_tracer().set_default_attrs()
         # Uninstall only our own recorder (another app may have replaced

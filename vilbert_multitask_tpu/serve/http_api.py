@@ -54,6 +54,8 @@ class ApiServer:
         pool=None,
         swap_fn=None,
         fleet=None,
+        attrib=None,
+        tracestore=None,
     ):
         self.queue = queue
         self.store = store
@@ -82,6 +84,14 @@ class ApiServer:
         # sharing the spine db, and /debug/trace?trace_id= stitches one
         # timeline across processes.
         self.fleet = fleet
+        # Cost-attribution plane (obs/attrib.py + obs/tracestore.py,
+        # ServeApp wires both): /debug/costs windows the attributor's
+        # completed ring, /debug/traces lists the durable tail-sampled
+        # store, /debug/autopsy renders one trace's stage waterfall, and
+        # /debug/trace?trace_id= falls back to the store when the span has
+        # aged out of every live ring.
+        self.attrib = attrib
+        self.tracestore = tracestore
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -148,6 +158,9 @@ class ApiServer:
                 collect_attention=("full" if collect == "full"
                                    else bool(collect)),
                 trace_id=trace_id,
+                # Optional caller-declared tenant for cost attribution
+                # (vmt_device_seconds_total{task,tenant}); absent → "anon".
+                tenant=str(payload.get("tenant", "") or "") or None,
                 # The deadline is minted HERE — queueing time counts against
                 # the budget, so a job stuck behind a backlog expires instead
                 # of burning a forward for a long-gone client.
@@ -265,6 +278,108 @@ class ApiServer:
                 for key, value in cache.items():
                     cg.set(value, key=str(key))
 
+    # ------------------------------------------------- cost attribution
+    def debug_costs(self, window_s: Optional[float],
+                    by: str) -> Tuple[int, Dict[str, Any]]:
+        """``GET /debug/costs?window_s=&by=tenant|task``: windowed cost
+        aggregates plus the device-second conservation verdict."""
+        if self.attrib is None:
+            return 200, {"enabled": False, "groups": {}}
+        body = self.attrib.window(window_s, by=by)
+        body["enabled"] = True
+        if self.tracestore is not None:
+            body["tracestore"] = self.tracestore.stats()
+        return 200, body
+
+    def debug_traces(self, *, verdict: Optional[str], task: Optional[str],
+                     tenant: Optional[str], scope: str,
+                     limit: int) -> Tuple[int, Dict[str, Any]]:
+        """``GET /debug/traces?verdict=slow&task=vqa``: stored-trace
+        summaries (``scope=fleet`` is the liveness-blind default)."""
+        if self.tracestore is None:
+            return 200, {"enabled": False, "traces": []}
+        # Push this process's buffered keeps first, same freshness
+        # contract as the fleet flush on /debug/trace.
+        try:
+            self.tracestore.flush()
+        except Exception:  # noqa: BLE001 — serve what's on disk
+            obs.REGISTRY.counter("vmt_tracestore_flush_errors_total").inc()
+        rows = self.tracestore.list(verdict=verdict, task=task,
+                                    tenant=tenant, scope=scope, limit=limit)
+        return 200, {"enabled": True, "scope": scope, "traces": rows,
+                     "stats": self.tracestore.stats()}
+
+    def stored_trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Chrome-trace doc rebuilt from the durable store — the
+        ``/debug/trace`` fallback once a trace has aged out of every live
+        span ring (including a dead peer's)."""
+        if self.tracestore is None:
+            return None
+        try:
+            self.tracestore.flush()
+            rec = self.tracestore.get(trace_id)
+        except Exception:  # noqa: BLE001
+            rec = None
+        if rec is None:
+            return None
+        events = [{
+            "name": s.get("name", ""), "ph": "X", "cat": "obs",
+            "ts": round(float(s.get("start_s", 0.0)) * 1e6, 3),
+            "dur": round(float(s.get("dur_s", 0.0)) * 1e6, 3),
+            "pid": 0, "tid": 0,
+            "args": {"trace_id": s.get("trace_id"),
+                     "span_id": s.get("span_id"),
+                     "parent_id": s.get("parent_id"),
+                     "thread_name": s.get("thread_name"),
+                     **(s.get("attrs") or {})},
+        } for s in rec.get("spans", [])]
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "stored": {k: rec.get(k) for k in
+                           ("ident", "verdict", "keep_reason", "dur_ms",
+                            "stored_unix")}}
+
+    def autopsy(self, trace_id: str) -> Tuple[int, Dict[str, Any]]:
+        """``GET /debug/autopsy?trace_id=``: one request's end-to-end
+        waterfall — stage charges in pipeline order, device share,
+        verdict, and the spans backing them (live record, falling back
+        to the durable store)."""
+        if not trace_id:
+            return 400, {"error": "need trace_id"}
+        cost: Optional[Dict[str, Any]] = None
+        source = None
+        if self.attrib is not None:
+            rec = self.attrib.get(trace_id)
+            if rec is not None:
+                cost, source = rec.as_dict(), "live"
+        spans = [s for s in obs.default_tracer().spans()
+                 if s.trace_id == trace_id]
+        span_dicts = [{"name": s.name, "start_s": s.start_s,
+                       "dur_s": s.dur_s, "thread_name": s.thread_name,
+                       "attrs": dict(s.attrs)} for s in spans]
+        if (cost is None or not span_dicts) and self.tracestore is not None:
+            try:
+                self.tracestore.flush()
+                stored = self.tracestore.get(trace_id)
+            except Exception:  # noqa: BLE001
+                stored = None
+            if stored is not None:
+                if cost is None and stored.get("cost"):
+                    cost, source = stored["cost"], "store"
+                if not span_dicts:
+                    span_dicts = stored.get("spans", [])
+        if cost is None and not span_dicts:
+            return 404, {"error": f"no cost record or stored trace for "
+                                  f"{trace_id}"}
+        stages = (cost or {}).get("stages", {})
+        waterfall = [{"stage": st, "ms": round(stages[st], 3)}
+                     for st in obs.COST_STAGES if st in stages]
+        return 200, {"trace_id": trace_id, "source": source,
+                     "verdict": (cost or {}).get("verdict"),
+                     "total_ms": (cost or {}).get("total_ms"),
+                     "device_s": (cost or {}).get("device_s"),
+                     "waterfall": waterfall, "cost": cost,
+                     "spans": span_dicts}
+
     # --------------------------------------------------------------- server
     def _make_handler(self):
         api = self
@@ -380,6 +495,11 @@ class ApiServer:
                     if q.get("format", [""])[0] == "prometheus":
                         self._serve_prometheus()
                         return
+                    if q.get("format", [""])[0] == "openmetrics":
+                        # OpenMetrics exposition: same samples plus bucket
+                        # exemplars linking straight to stored trace ids.
+                        self._serve_openmetrics()
+                        return
                     snap = (api.metrics.snapshot()
                             if api.metrics is not None else {})
                     snap["queue"] = api.queue.counts()
@@ -448,15 +568,61 @@ class ApiServer:
                         except Exception:  # noqa: BLE001 — serve what's there
                             obs.REGISTRY.counter(
                                 "vmt_fleet_flush_errors_total").inc()
-                        self._json(200, api.fleet.chrome_trace(
-                            trace_id, limit=limit))
+                        doc = api.fleet.chrome_trace(trace_id, limit=limit)
+                        if trace_id is not None and not any(
+                                e.get("ph") == "X"
+                                for e in doc.get("traceEvents", [])):
+                            # Aged out of every peer's span window — the
+                            # durable store is the last line of autopsy.
+                            stored = api.stored_trace(trace_id)
+                            if stored is not None:
+                                self._json(200, stored)
+                                return
+                        self._json(200, doc)
                         return
                     if trace_id is not None:
                         spans = [s for s in obs.default_tracer().spans()
                                  if s.trace_id == trace_id]
+                        if not spans:
+                            stored = api.stored_trace(trace_id)
+                            if stored is not None:
+                                self._json(200, stored)
+                                return
                         self._json(200, obs.chrome_trace(spans=spans))
                         return
                     self._json(200, obs.chrome_trace(limit=limit))
+                elif (path == "/debug/costs"
+                      or path.startswith("/debug/costs?")):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        window = float(q.get("window_s", ["0"])[0]) or None
+                    except ValueError:
+                        window = None
+                    self._json(*api.debug_costs(
+                        window, q.get("by", ["task"])[0]))
+                elif (path == "/debug/traces"
+                      or path.startswith("/debug/traces?")):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["50"])[0])
+                    except ValueError:
+                        limit = 50
+                    self._json(*api.debug_traces(
+                        verdict=q.get("verdict", [""])[0] or None,
+                        task=q.get("task", [""])[0] or None,
+                        tenant=q.get("tenant", [""])[0] or None,
+                        scope=q.get("scope", ["fleet"])[0] or "fleet",
+                        limit=max(1, min(limit, 500))))
+                elif (path == "/debug/autopsy"
+                      or path.startswith("/debug/autopsy?")):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    self._json(*api.autopsy(q.get("trace_id", [""])[0]))
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -466,10 +632,19 @@ class ApiServer:
 
             def _serve_prometheus(self) -> None:
                 api.refresh_gauges()
-                extra = ([api.metrics.latency]
-                         if api.metrics is not None
-                         and hasattr(api.metrics, "latency") else [])
-                self._send_prometheus(obs.render_prometheus(extra=extra))
+                self._send_prometheus(
+                    obs.render_prometheus(extra=self._extra_instruments()))
+
+            def _serve_openmetrics(self) -> None:
+                api.refresh_gauges()
+                self._send_text(
+                    obs.render_openmetrics(extra=self._extra_instruments()),
+                    obs.OPENMETRICS_CONTENT_TYPE)
+
+            def _extra_instruments(self):
+                return ([api.metrics.latency]
+                        if api.metrics is not None
+                        and hasattr(api.metrics, "latency") else [])
 
             def _serve_fleet_prometheus(self) -> None:
                 if api.fleet is None:
@@ -486,10 +661,12 @@ class ApiServer:
                 self._send_prometheus(api.fleet.render_prometheus())
 
             def _send_prometheus(self, text: str) -> None:
+                self._send_text(text, obs.PROMETHEUS_CONTENT_TYPE)
+
+            def _send_text(self, text: str, ctype: str) -> None:
                 body = text.encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 obs.PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

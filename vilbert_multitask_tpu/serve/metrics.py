@@ -44,8 +44,12 @@ class Metrics:
         # compared against deploy timestamps) — not a duration measurement.
         self._started = time.time()
 
-    def record(self, task_id: int, latency_ms: float) -> None:
-        self._lat.observe(latency_ms, task=str(task_id))
+    def record(self, task_id: int, latency_ms: float, *,
+               exemplar_trace_id: Optional[str] = None) -> None:
+        # The exemplar links this sample's histogram bucket to its stored
+        # trace (OpenMetrics exposition + SLO page payloads follow it).
+        self._lat.observe(latency_ms, exemplar_trace_id=exemplar_trace_id,
+                          task=str(task_id))
 
     def record_failure(self, task_id: Optional[int] = None) -> None:
         with self._lock:

@@ -319,7 +319,8 @@ def make_job_message(image_paths, question: str, task_id: int,
                      collect_attention: "bool | str" = False,
                      trace_id: "str | None" = None,
                      deadline: "Dict[str, float] | None" = None,
-                     published_unix: "float | None" = None
+                     published_unix: "float | None" = None,
+                     tenant: "str | None" = None
                      ) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
     list of absolute paths, ``question`` the (pre-lowercased) query.
@@ -354,4 +355,9 @@ def make_job_message(image_paths, question: str, task_id: int,
         # turns it into vmt_queue_wait_ms, the publish→claim delay that
         # intake-anchored e2e latency cannot see.
         msg["published_unix"] = published_unix
+    if tenant:
+        # Cost-attribution billing dimension (obs/attrib.py): who to
+        # charge this job's device-seconds to. Absent means "anon" —
+        # the attributor defaults it, so old producers stay valid.
+        msg["tenant"] = tenant
     return msg

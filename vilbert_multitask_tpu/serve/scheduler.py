@@ -297,6 +297,8 @@ class ContinuousScheduler:
         now = self.clock()
         for item in batch:
             obs.SCHED_WAIT.observe(max(now - item.enq_t, 0.0) * 1e3)
+            obs.job_charge(item.job.body.get("trace_id", ""),
+                           "ready_wait", max(now - item.enq_t, 0.0))
         packed = [i for i in batch if not i.solo]
         solos = [i for i in batch if i.solo]
         for item in solos:
@@ -324,12 +326,15 @@ class ContinuousScheduler:
     def _dispatch_packed(self, packed: List[ReadyItem], rep) -> None:
         """Forward one packed batch on one engine (a checked-out replica,
         or the worker's own engine in legacy mode) and stream results."""
+        t_pack = time.perf_counter()
         engine = rep.engine if rep is not None else self.worker.engine
         reqs = [i.prepared for i in packed]
         plan = engine.chunk_plan([r.n_images for r in reqs])
+        top_bucket = 0
         for idxs in plan:
             rows = sum(reqs[i].n_images for i in idxs)
             bucket = engine.cfg.engine.row_bucket_for(rows)
+            top_bucket = max(top_bucket, bucket)
             obs.BATCH_FILL.observe(rows / bucket, bucket=str(bucket))
             obs.BATCHES_DISPATCHED.inc()
         with self._cond:
@@ -344,11 +349,27 @@ class ContinuousScheduler:
             # piling unpersisted results without bound.
             self._completions.put((packed[pos], result))
 
+        rep_name = rep.name if rep is not None else ""
+        t_fwd = time.perf_counter()
+        for item in packed:
+            obs.job_charge(item.job.body.get("trace_id", ""), "pack",
+                           t_fwd - t_pack)
+        rows_total = sum(r.n_images for r in reqs)
+
+        def _charge_forward(wall_s, members) -> None:
+            # Amortized device share per member (attrib double-entry: the
+            # FULL wall lands on the busy ledger, only listed members are
+            # billed — a mid-batch failure's unstreamed rows show as waste).
+            obs.job_batch(
+                wall_s,
+                [(i.job.body.get("trace_id", ""), i.prepared.n_images)
+                 for i in members],
+                batch_rows=rows_total, bucket=top_bucket, replica=rep_name)
+
         try:
-            t_fwd = time.perf_counter()
             with obs.span("worker.batch_forward", n_jobs=len(packed),
                           job_ids=[i.job.id for i in packed],
-                          replica=rep.name if rep is not None else ""):
+                          replica=rep_name):
                 engine.run_many(reqs, on_result=_on_result)
             # Attribute the shared forward window into each member's own
             # trace (same contract as step_batch) so per-request
@@ -360,11 +381,15 @@ class ContinuousScheduler:
                     trace_id=item.job.body.get("trace_id"),
                     job_id=item.job.id, task_id=item.prepared.spec.task_id,
                     batched=True, n_jobs=len(packed))
+            _charge_forward(dur_fwd, packed)
             if rep is not None:
                 self.pool.checkin(
                     rep, ok=True,
                     elapsed_ms=(time.perf_counter() - t_fwd) * 1e3)
         except Exception as e:  # noqa: BLE001 — split below
+            _charge_forward(time.perf_counter() - t_fwd,
+                            [i for pos, i in enumerate(packed)
+                             if pos in streamed])
             if rep is not None:
                 self.pool.checkin(rep, ok=False, error=e)
                 rep.failovers += 1

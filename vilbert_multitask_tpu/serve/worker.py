@@ -147,6 +147,8 @@ class ServeWorker:
         # edited/replaced file is a cache miss.
         prepared = self.engine.prepare_from_store(task_id, question,
                                                   image_paths)
+        obs.job_charge(body.get("trace_id", ""), "intake",
+                       time.perf_counter() - t0)
         return qa_id, prepared, t0
 
     def process_job(self, job: Job) -> Dict[str, Any]:
@@ -190,6 +192,12 @@ class ServeWorker:
                 "worker.claim", t0, time.perf_counter() - t0,
                 trace_id=job.body.get("trace_id"), job_id=job.id,
                 attempts=job.attempts, claimed_by=ident)
+            # Cost attribution opens at claim: every stage charge between
+            # here and the terminal verdict lands on this record.
+            trace_id = job.body.get("trace_id", "")
+            obs.job_begin(trace_id, job_id=job.id,
+                          task=str(job.body.get("task_id", "")),
+                          tenant=str(job.body.get("tenant") or "anon"))
             published = job.body.get("published_unix")
             if published is not None:
                 # Publish→claim latency. Wall-clock delta against the
@@ -201,6 +209,7 @@ class ServeWorker:
                 obs.QUEUE_WAIT.observe(
                     max(wait_s, 0.0) * 1e3,
                     task=str(job.body.get("task_id", "")))
+                obs.job_charge(trace_id, "queue_wait", max(wait_s, 0.0))
             with self._inflight_lock:
                 self._inflight[job.id] = job
         return job
@@ -222,6 +231,9 @@ class ServeWorker:
                              trace_id=job.body.get("trace_id"),
                              task_id=job.body.get("task_id", ""),
                              deliveries=job.deliveries)
+            # Close any cost record a dead prior holder left open, so the
+            # quarantine verdict (not an eviction) is what the store keeps.
+            obs.job_finish(job.body.get("trace_id", ""), "dead_letter")
             log_to_terminal(
                 self.hub, job.body.get("socket_id", ""),
                 {"terminal": "Job quarantined: it was delivered "
@@ -248,6 +260,7 @@ class ServeWorker:
             replica=replica)
         self.queue.release(job.id)
         self._untrack(job.id)
+        obs.job_finish(job.body.get("trace_id", ""), "failover")
         log_to_terminal(
             self.hub, job.body.get("socket_id", ""),
             {"terminal": f"Replica {replica} failed mid-inference; job "
@@ -303,6 +316,7 @@ class ServeWorker:
              "question": job.body.get("question", "")})
         self.queue.ack(job.id)
         self._untrack(job.id)
+        obs.job_finish(job.body.get("trace_id", ""), "deadline")
 
     def step(self) -> Optional[str]:
         """Claim and run one job. Returns 'acked'/'failed'/None."""
@@ -399,6 +413,16 @@ class ServeWorker:
                     trace_id=job.body.get("trace_id"), job_id=job.id,
                     task_id=p.spec.task_id, batched=True,
                     n_jobs=len(packable))
+            # Amortize the shared forward into each member's cost record
+            # (no streaming here: success means every member gets a share).
+            rows_total = sum(p.n_images for _, _, p, _ in packable)
+            obs.job_batch(
+                dur_fwd,
+                [(j.body.get("trace_id", ""), p.n_images)
+                 for j, _, p, _ in packable],
+                batch_rows=rows_total,
+                bucket=self.engine.cfg.engine.row_bucket_for(rows_total),
+                replica=getattr(self.engine, "replica_id", "") or "")
         except ReplicaFailover as e:
             # The REPLICA died under this batch, not the jobs: release the
             # whole batch for redelivery on a healthy replica. No member
@@ -427,6 +451,8 @@ class ServeWorker:
         """Marshal + persist + push for one completed request."""
         body = job.body
         socket_id = body.get("socket_id", "")
+        trace_id = body.get("trace_id", "")
+        t_dec = time.perf_counter()
         payload = result.to_json()
         payload["question"] = body.get("question", "")
         payload["task_name"] = req.spec.name
@@ -464,12 +490,17 @@ class ServeWorker:
                       task_id=req.spec.task_id):
             self.store.save_answer(qa_id, payload, answer_images)
         elapsed_ms = (time.perf_counter() - t0) * 1e3
-        self.metrics.record(req.spec.task_id, elapsed_ms)
+        self.metrics.record(req.spec.task_id, elapsed_ms,
+                            exemplar_trace_id=trace_id)
+        obs.job_charge(trace_id, "decode", time.perf_counter() - t_dec)
+        t_push = time.perf_counter()
         with obs.span("worker.push", task_id=req.spec.task_id):
             log_to_terminal(self.hub, socket_id, {"result": payload})
             log_to_terminal(
                 self.hub, socket_id,
                 {"terminal": f"Task completed in {elapsed_ms:.0f} ms"})
+        obs.job_charge(trace_id, "push", time.perf_counter() - t_push)
+        obs.job_finish(trace_id, "ok")
         return payload
 
     def _fail_job(self, job: Job) -> str:
@@ -484,6 +515,10 @@ class ServeWorker:
                          error=traceback.format_exc(limit=5))
         status = self.queue.nack(job.id)
         self._untrack(job.id)
+        # A requeued attempt closes THIS record; the redelivery's claim
+        # opens a fresh one under the same trace id.
+        obs.job_finish(job.body.get("trace_id", ""),
+                       "dead_letter" if status == "dead" else "requeued")
         if status == "dead":
             log_to_terminal(
                 self.hub, job.body.get("socket_id", ""),

@@ -57,6 +57,15 @@ echo "== protocol surface (PROTOCOL_SURFACE.json vs the tree) =="
 # rerun `python -m vilbert_multitask_tpu.analysis proto` and commit.
 python -m vilbert_multitask_tpu.analysis proto --check || fail=1
 
+echo "== failure surface (FAILURE_SURFACE.json vs the tree) =="
+# The committed manifest enumerates the exception-flow boundaries (thread
+# entry points, HTTP verbs, sampler ticks, breaker regions, fault sites)
+# with the escaping-exception set and verdict the exc tier proved for
+# each. Drift means an error path changed without regenerating the
+# contract — rerun `python -m vilbert_multitask_tpu.analysis exc` and
+# commit.
+python -m vilbert_multitask_tpu.analysis exc --check || fail=1
+
 echo "== exactly-one-terminal invariant (VMT132 clean scan) =="
 # The load-bearing serving invariant, proved statically over every CFG
 # path: any unbaselined VMT132 finding anywhere in the library tree
@@ -118,6 +127,15 @@ echo "== chaos smoke (seeded FaultPlan, no-lost-jobs invariant) =="
 # flight recorder must capture an injected fault's trace.
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --chaos --jobs 15 \
   --out /tmp/CHAOS_SOAK.json || fail=1
+
+echo "== thread-kill smoke (seeded intake-thread death, watchdog visibility) =="
+# One-shot queue.claim fault kills one scheduler intake thread mid-burst
+# through the exc tier's VMT137 witness path. Gate: /healthz names the
+# dead thread within one sampler cadence, the thread_died bundle lands,
+# and the surviving intake threads drain every job to exactly one
+# terminal state.
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --kill-thread --jobs 15 \
+  --out /tmp/THREADKILL_SOAK.json || fail=1
 
 echo "== scheduler smoke (continuous batching >= solo loop, no lost jobs) =="
 # Same burst twice through one engine: serial batch=1 loop vs. the

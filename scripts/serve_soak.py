@@ -70,6 +70,8 @@ def _ledger_verdict(report: dict, verdict: bool,
             metric += ".full"
         if "chaos" in report:
             metric += ".chaos"
+        if "threadkill" in report:
+            metric += ".threadkill"
         values = {}
         for k in ("value", "e2e_p50_ms", "e2e_p95_ms", "boot_s",
                   "makespan_s", "qps_ratio_vs_1_replica", "baseline_qps",
@@ -169,6 +171,41 @@ def _chaos_plan(seed: int):
         FaultRule("queue.claim", "delay", rate=0.3, delay_s=0.02),
         FaultRule("worker.intake", "error", rate=0.05),
     ])
+
+
+def _threadkill_plan(seed: int):
+    """One-shot thread assassination through the real fault path: the
+    first ``queue.claim`` after install raises FaultInjected. The claim
+    at the top of the scheduler's intake pump sits outside the intake
+    try/except (the exc tier's VMT137 witness), so the injection rides
+    the exact path that used to kill the thread silently — now the
+    crash guard must turn it into a ``thread_died`` bundle and an
+    unready ``/healthz`` while the surviving intake threads drain the
+    burst."""
+    from vilbert_multitask_tpu.resilience import FaultPlan, FaultRule
+
+    return FaultPlan(seed, [
+        FaultRule("queue.claim", "error", rate=1.0, max_injections=1),
+    ])
+
+
+def _ledger_threadkill(report: dict, verdict: bool) -> None:
+    """Ledger the thread-kill verdict under its own metric: detection
+    latency trends independently of qps, and check() baselines are
+    per-metric medians."""
+    try:
+        from vilbert_multitask_tpu import obs
+
+        tk = report.get("threadkill") or {}
+        values = {k: v for k, v in tk.items()
+                  if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        if values:
+            obs.ledger_append("soak.threadkill", values, extra={
+                "verdict": "pass" if verdict else "fail",
+                "dead_thread": tk.get("dead_thread"),
+            })
+    except Exception as e:  # noqa: BLE001 — ride-along must never fail the soak
+        print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
 
 
 def _chaos_worker(app, retry_budget_hint: float = 1e6):
@@ -555,7 +592,17 @@ def main(argv=None) -> int:
                    help="pool soak: add a seeded chaos burst that kills "
                         "one replica mid-burst and asserts failover "
                         "invariants")
+    p.add_argument("--kill-thread", action="store_true",
+                   help="kill one scheduler intake thread mid-burst via a "
+                        "one-shot queue.claim fault; asserts /healthz "
+                        "turns unready within a sampler cadence, the "
+                        "thread_died bundle lands, and the surviving "
+                        "threads still drain every job to exactly one "
+                        "terminal")
     args = p.parse_args(argv)
+    assert not (args.chaos and args.kill_thread), \
+        "--kill-thread drains through the in-process scheduler; --chaos " \
+        "drains through a remote worker — pick one"
 
     if args.dryrun or args.replicas > 1 or args.kill_replica:
         # Pool mode is dryrun by definition: replica scaling on a shared
@@ -586,6 +633,7 @@ def main(argv=None) -> int:
         QUEUE_WAIT,
         SHED_COUNTER,
         percentile,
+        watchdog,
     )
     from vilbert_multitask_tpu.resilience import clear_plan, install_plan
     from vilbert_multitask_tpu.serve.app import ServeApp
@@ -682,7 +730,14 @@ def main(argv=None) -> int:
     submitted: dict = {}
     trace_by_q: dict = {}  # question → trace_id (the attribution key)
     t_burst = time.perf_counter()
+    t_kill = None
     for i in range(args.jobs):
+        if args.kill_thread and plan is None and i == max(1, args.jobs // 2):
+            # Mid-burst assassination: the next intake claim anywhere
+            # dies. Installed between submits so jobs are in flight on
+            # both sides of the death.
+            plan = install_plan(_threadkill_plan(args.seed))
+            t_kill = time.perf_counter()
         task_id, q_t, n_img = PATTERN[i % len(PATTERN)]
         q = q_t.format(i=i)
         body = json.dumps({
@@ -700,6 +755,32 @@ def main(argv=None) -> int:
         assert resp.status == 200, resp.read()
         trace_by_q[q.lower()] = json.loads(resp.read()).get("trace_id", "")
         submitted[q.lower()] = t_submit
+
+    tk_detect: dict = {}
+    if args.kill_thread:
+        # Detection race: crash_guard files the death synchronously with
+        # the injected claim, so /healthz must flip 503 with the dead
+        # thread named well inside one sampler cadence. Poll on a fresh
+        # connection (the main one is reserved for /debug/slo later).
+        cadence = cfg.serving.sampler_cadence_s
+        hconn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                           timeout=5)
+        deadline_t = t_kill + cadence + 2.0  # poll past the bar; gate below
+        while time.perf_counter() < deadline_t:
+            hconn.request("GET", "/healthz")
+            r = hconn.getresponse()
+            body = json.loads(r.read())
+            dead = (body.get("threads") or {}).get("dead") or {}
+            if r.status == 503 and dead:
+                tk_detect = {
+                    "detect_s": round(time.perf_counter() - t_kill, 3),
+                    "dead": dead,
+                    "reason": body.get("reason"),
+                }
+                break
+            time.sleep(0.01)
+        hconn.close()
+        clear_plan()  # one-shot already spent; teardown stays fault-free
 
     ok = done.wait(timeout=600)
     if args.chaos:
@@ -862,6 +943,47 @@ def main(argv=None) -> int:
         # and the flight recorder captured an injected fault's trace.
         verdict = (no_job_lost and exactly_one and len(faulted) >= 3
                    and trace_in_spans and failed_traces_stored)
+    elif args.kill_thread:
+        # Thread-kill acceptance: exactly one intake thread died through
+        # the guarded fault path, /healthz named it within one sampler
+        # cadence (+0.5s poll slack), the flight recorder flushed its
+        # thread_died bundle (app.stop() closed the recorder above), and
+        # the surviving intake threads still drained every job to
+        # exactly one terminal.
+        dead_after = watchdog().dead_threads()
+        intake_dead = sorted(n for n in dead_after
+                             if n.startswith("sched-intake-"))
+        tk_bundle = None
+        for path in app.recorder.bundles():
+            try:
+                with open(path) as f:
+                    b = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if b.get("event") == "thread_died":
+                tk_bundle = os.path.basename(path)
+                break
+        no_job_lost = bool(ok and len(terminals) == args.jobs)
+        exactly_one = not dup_terminals
+        detected = bool(
+            tk_detect
+            and tk_detect["detect_s"] <= cfg.serving.sampler_cadence_s + 0.5
+            and any(n.startswith("sched-intake-") for n in tk_detect["dead"]))
+        report["threadkill"] = {
+            "seed": args.seed,
+            "injections": plan.injections() if plan is not None else {},
+            "sampler_cadence_s": cfg.serving.sampler_cadence_s,
+            "detect_s": tk_detect.get("detect_s"),
+            "healthz_reason": tk_detect.get("reason"),
+            "dead_thread": ",".join(intake_dead),
+            "dead_threads": dead_after,
+            "thread_died_bundle": tk_bundle,
+            "no_job_lost": no_job_lost,
+            "exactly_one_terminal": exactly_one,
+            "duplicates": dup_terminals,
+        }
+        verdict = (no_job_lost and exactly_one and detected
+                   and len(intake_dead) == 1 and tk_bundle is not None)
     else:
         cons_ok = (not cost_attrib["enabled"]
                    or abs(cost_attrib["device_s_conservation"] - 1.0)
@@ -869,6 +991,8 @@ def main(argv=None) -> int:
         verdict = report["all_completed"] and cons_ok
     _ledger_verdict(report, verdict)
     _ledger_attrib(report, verdict)
+    if args.kill_thread:
+        _ledger_threadkill(report, verdict)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report), flush=True)

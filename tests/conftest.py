@@ -138,22 +138,40 @@ def _no_implicit_transfers(request):
 
 # ------------------------------------------------------ obs thread hygiene
 @pytest.fixture(autouse=True)
-def _no_leaked_obs_threads():
-    """ServeApp.stop() must JOIN the background sampler and flight-recorder
-    writer — a test that boots the live-health plane and leaks either
-    thread would keep sampling freed state under every later test. The
-    guard is name-based: those threads exist nowhere else."""
-    yield
+def _no_leaked_project_threads():
+    """Every thread a test spawns must be accounted for when it ends:
+    the sampler and flight-recorder writer joined (stop()/close()
+    contract — leaking either keeps sampling freed state under every
+    later test), any other non-daemon thread joined, and any *named*
+    daemon thread registered with the obs watchdog (a crash-guarded
+    loop announces itself; an anonymous stdlib helper gets a pass)."""
     import threading
 
+    before = {id(t) for t in threading.enumerate()}
+    yield
     from vilbert_multitask_tpu import obs
 
-    leaked = [t.name for t in threading.enumerate()
-              if t.name in (obs.SAMPLER_THREAD_NAME,
-                            obs.RECORDER_THREAD_NAME)]
+    # Default/stdlib naming schemes: unnamed threads, pool workers, and
+    # asyncio helpers — not project loops, not watchdog material.
+    stdlib_names = ("MainThread", "Thread-", "ThreadPoolExecutor",
+                    "asyncio_", "Dummy-")
+    wd = obs.watchdog()
+    leaked = []
+    for t in threading.enumerate():
+        if id(t) in before or not t.is_alive():
+            continue
+        if t.name in (obs.SAMPLER_THREAD_NAME,
+                      obs.RECORDER_THREAD_NAME):
+            leaked.append(f"{t.name} (stop()/close() must join it)")
+        elif not t.daemon:
+            leaked.append(f"{t.name} (non-daemon thread never joined)")
+        elif not t.name.startswith(stdlib_names) \
+                and not wd.is_known_thread(t.name):
+            leaked.append(f"{t.name} (named daemon thread unknown to "
+                          f"the watchdog registry — run its loop under "
+                          f"obs.crash_guard or join it)")
     assert not leaked, (
-        f"obs background threads leaked by this test: {leaked} — "
-        f"stop()/close() must join them")
+        f"project threads leaked by this test: {leaked}")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,441 @@
+"""Exception tier suite: escape fixture pairs for VMT137-140 (thread
+escapes, breaker blindness, terminal-shadowing handlers, error-frame
+drift), cross-module escape composition and tuple-handler narrowing,
+the real-tree pins (guarded scheduler threads, the one baselined blind
+breaker), and the failure manifest (FAILURE_SURFACE.json) —
+determinism, drift detection, and the byte-for-byte committed gate CI
+runs via ``exc --check``.
+
+Rule fixtures are multi-module dicts through ``analyze_project``: raise
+sites in one module must compose through calls into the thread entry
+that another module spawns, exactly like the worker/scheduler split.
+"""
+
+import copy
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from vilbert_multitask_tpu.analysis import analyze_project
+from vilbert_multitask_tpu.analysis import exc as exc_mod
+from vilbert_multitask_tpu.analysis.exc import (
+    build_failure_surface,
+    diff_failure_surface,
+    exc_flow,
+    render_failure_surface,
+    render_failure_surface_sarif,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, exc_mod.MANIFEST_NAME)
+
+
+def findings(sources):
+    return analyze_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()},
+        library_roots=("pkg", "vilbert_multitask_tpu"))
+
+
+def rules_hit(sources):
+    return {f.rule for f in findings(sources)}
+
+
+def _tree_sources():
+    """The exact source set the exc CLI loads: configured paths minus
+    excludes (escape summaries compose through everything the config
+    scans; boundaries bind only library code)."""
+    from vilbert_multitask_tpu.analysis.config import load_config
+    from vilbert_multitask_tpu.analysis.core import iter_python_files
+
+    cfg, root = load_config(REPO)
+    root = root or REPO
+    roots = [os.path.join(root, p) for p in cfg.paths]
+    out = {}
+    for path in iter_python_files(
+            [r for r in roots if os.path.exists(r)], exclude=cfg.exclude):
+        rel = os.path.relpath(os.path.abspath(path),
+                              root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            out[rel] = f.read()
+    return out
+
+
+def _project(sources):
+    from vilbert_multitask_tpu.analysis import surface as surf_mod
+
+    return surf_mod.load_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()})
+
+
+@pytest.fixture(scope="module")
+def repo_project():
+    return _project(_tree_sources())
+
+
+@pytest.fixture(scope="module")
+def repo_exc(repo_project):
+    return exc_flow(repo_project)
+
+
+@pytest.fixture(scope="module")
+def fresh_surface(repo_exc, repo_project):
+    return build_failure_surface(repo_project)
+
+
+# ----------------------------------------------------------------- VMT137
+def test_vmt137_thread_escape_cross_module():
+    # The raise lives two modules away from the ctor: helper (pkg/b)
+    # raises, loop (pkg/a) calls it, the spawn site sees the composed
+    # escape with a witness chain back to the raise.
+    srcs = {"pkg/b.py": """
+    def helper(job):
+        if job is None:
+            raise ValueError("no job")
+        return job
+    """, "pkg/a.py": """
+    import threading
+
+    from pkg.b import helper
+
+    class Pump:
+        def loop(self):
+            while True:
+                helper(self.next())
+
+        def start(self):
+            t = threading.Thread(target=self.loop, name="pump",
+                                 daemon=True)
+            t.start()
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT137"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert "`pump`" in f.message and "`ValueError`" in f.message
+    assert "crash_guard" in f.message
+    # The witness chain walks raise -> call -> entry.
+    assert f.flows and f.flows[0][0]["path"] == "pkg/b.py"
+
+
+def test_vmt137_crash_guarded_loop_is_clean():
+    srcs = {"pkg/a.py": """
+    import threading
+
+    from vilbert_multitask_tpu.obs import crash_guard
+
+    class Pump:
+        def loop(self):
+            with crash_guard("pump"):
+                while True:
+                    self.step()
+
+        def step(self):
+            raise ValueError("boom")
+
+        def start(self):
+            threading.Thread(target=self.loop, name="pump").start()
+    """}
+    assert "VMT137" not in rules_hit(srcs)
+
+
+def test_vmt137_tuple_alias_handler_narrows():
+    # ``except _ERRS`` resolves the module tuple alias: KeyError is
+    # caught, so nothing escapes; flipping the raise to RuntimeError
+    # (outside the tuple) must fire.
+    caught = {"pkg/a.py": """
+    import threading
+
+    _ERRS = (ValueError, KeyError)
+
+    class Pump:
+        def loop(self):
+            try:
+                self.step()
+            except _ERRS:
+                pass
+
+        def step(self):
+            raise KeyError("k")
+
+        def start(self):
+            threading.Thread(target=self.loop, name="pump").start()
+    """}
+    assert "VMT137" not in rules_hit(caught)
+    escapes = {"pkg/a.py": caught["pkg/a.py"].replace(
+        'raise KeyError("k")', 'raise RuntimeError("r")')}
+    fs = [f for f in findings(escapes) if f.rule == "VMT137"]
+    assert len(fs) == 1 and "`RuntimeError`" in fs[0].message
+
+
+def test_vmt137_exit_exceptions_are_not_deaths():
+    srcs = {"pkg/a.py": """
+    import threading
+
+    class Pump:
+        def loop(self):
+            raise SystemExit(0)
+
+        def start(self):
+            threading.Thread(target=self.loop, name="pump").start()
+    """}
+    assert "VMT137" not in rules_hit(srcs)
+
+
+# ----------------------------------------------------------------- VMT138
+_BREAKER_CALL = """
+class Client:
+    def _attempt(self):
+        raise {raises}("x")
+
+    def post(self):
+        return self.retry.call(
+            self._attempt, site="x.post", retry_on=(ValueError,),
+            {no_retry}breaker=self.breaker)
+"""
+
+
+def test_vmt138_no_retry_and_uncovered_escape_are_blind():
+    srcs = {"pkg/c.py": _BREAKER_CALL.format(
+        raises="RuntimeError", no_retry="no_retry=(KeyError,), ")}
+    fs = [f for f in findings(srcs) if f.rule == "VMT138"]
+    assert len(fs) == 1
+    # Both blindness modes in one region: the declared no_retry class
+    # re-raises without recording, and the callee's RuntimeError is
+    # outside retry_on so the recording clause never sees it.
+    assert "`KeyError`" in fs[0].message
+    assert "`RuntimeError`" in fs[0].message
+    assert "x.post" in fs[0].message
+
+
+def test_vmt138_covered_callee_is_observed():
+    srcs = {"pkg/c.py": _BREAKER_CALL.format(
+        raises="ValueError", no_retry="")}
+    assert "VMT138" not in rules_hit(srcs)
+
+
+# ----------------------------------------------------------------- VMT139
+_QUEUE = """
+class Queue:
+    def claim(self):
+        return self._pop()
+
+    def ack(self, job_id):
+        self._settle(job_id, "done")
+
+    def nack(self, job_id):
+        self._settle(job_id, "retry")
+
+    def release(self, job_id):
+        self._settle(job_id, "requeue")
+"""
+
+_SHADOW = """
+class Worker:
+    def drain(self):
+        job = self.queue.claim()
+        try:
+            self.handle(job)
+        except Exception:
+            {handler}
+"""
+
+
+def test_vmt139_broad_handler_shadows_owed_terminal():
+    srcs = {"pkg/q.py": _QUEUE,
+            "pkg/w.py": _SHADOW.format(handler="self.log(job)")}
+    fs = [f for f in findings(srcs) if f.rule == "VMT139"]
+    assert len(fs) == 1
+    assert "owes a terminal" in fs[0].message
+
+
+def test_vmt139_handler_reaching_terminal_is_clean():
+    srcs = {"pkg/q.py": _QUEUE,
+            "pkg/w.py": _SHADOW.format(handler="self.queue.nack(job.id)")}
+    assert "VMT139" not in rules_hit(srcs)
+
+
+def test_vmt139_reraising_handler_is_clean():
+    srcs = {"pkg/q.py": _QUEUE,
+            "pkg/w.py": _SHADOW.format(handler="raise")}
+    assert "VMT139" not in rules_hit(srcs)
+
+
+# ----------------------------------------------------------------- VMT140
+_STORE = """
+import sqlite3
+
+class Store:
+    def boot(self):
+        with sqlite3.connect(self.path) as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS jobs ("
+                "id INTEGER PRIMARY KEY, "
+                "status TEXT NOT NULL DEFAULT 'pending')")
+
+    def claim(self, now):
+        with sqlite3.connect(self.path) as c:
+            c.execute("UPDATE jobs SET status='inflight' WHERE id=?",
+                      (now,))
+
+    def bury(self, job_id):
+        with sqlite3.connect(self.path) as c:
+            c.execute("UPDATE jobs SET status='dead' WHERE id=?",
+                      (job_id,))
+"""
+
+
+def test_vmt140_handler_verdict_drift_with_did_you_mean():
+    srcs = {"pkg/store.py": _STORE, "pkg/w.py": """
+    def finish(job):
+        try:
+            work(job)
+        except Exception:
+            emit(job.id, verdict="inflght")
+    """}
+    fs = [f for f in findings(srcs) if f.rule == "VMT140"]
+    assert len(fs) == 1
+    assert fs[0].severity == "warning"
+    assert "inflght" in fs[0].message and "`inflight`" in fs[0].message
+
+
+def test_vmt140_machine_value_in_handler_is_clean():
+    srcs = {"pkg/store.py": _STORE, "pkg/w.py": """
+    def finish(job):
+        try:
+            work(job)
+        except Exception:
+            emit(job.id, verdict="dead")
+    """}
+    assert "VMT140" not in rules_hit(srcs)
+
+
+def test_vmt140_nonhandler_literals_extend_the_vocabulary():
+    # A verdict emitted on the happy path joins the vocabulary, so the
+    # handler reusing it is clean — only handler-only inventions drift.
+    srcs = {"pkg/store.py": _STORE, "pkg/w.py": """
+    def finish(job):
+        emit(job.id, verdict="failover")
+        try:
+            work(job)
+        except Exception:
+            emit(job.id, verdict="failover")
+    """}
+    assert "VMT140" not in rules_hit(srcs)
+
+
+# ------------------------------------------------------ the real tree
+def test_repo_scheduler_threads_are_guarded(repo_exc):
+    # The PR's runtime fix, pinned: the three thread boundaries the exc
+    # tier proved escaping (claim outside the intake try) now run under
+    # obs.crash_guard.
+    by_name = {b["name"]: b for b in repo_exc.boundaries
+               if b["kind"] == "thread"}
+    for name in ("sched-intake-*", "sched-completion", "serve-worker"):
+        assert by_name[name]["verdict"] == "guarded", by_name[name]
+        assert by_name[name]["guard"]
+
+
+def test_repo_no_unguarded_thread_escapes(repo_exc):
+    assert not repo_exc.thread_findings
+
+
+def test_repo_remote_post_is_the_only_blind_breaker(repo_exc):
+    blind = [b for b in repo_exc.boundaries
+             if b["kind"] == "breaker" and b["verdict"] == "blind"]
+    assert len(blind) == 1
+    assert blind[0]["name"] == "remote.post"
+    # HTTPError is deliberate (deterministic server verdict, baselined
+    # in vmtlint_baseline.json) — anything else joining it is a leak.
+    assert sorted(blind[0]["escapes"]) == ["HTTPError"]
+
+
+def test_repo_fault_sites_all_enumerated(repo_exc):
+    sites = {b["name"] for b in repo_exc.boundaries
+             if b["kind"] == "fault-site"}
+    assert sites == {"queue.publish", "queue.claim", "worker.intake",
+                     "remote.post", "push.publish", "engine.dispatch"}
+
+
+def test_warm_exc_build_fits_the_lint_budget(repo_project):
+    # proto/txn are separate tiers (already cached on the project); the
+    # exc tier's own fixed point + boundary discovery must stay under
+    # the 2s wall the check.sh lint budget allows it.
+    t0 = time.perf_counter()
+    exc_mod.ExcFlow(repo_project)
+    assert time.perf_counter() - t0 < 2.0
+
+
+# ------------------------------------------------------- the manifest
+def test_surface_is_deterministic():
+    srcs = {"pkg/a.py": """
+    import threading
+
+    class P:
+        def loop(self):
+            raise ValueError("x")
+
+        def start(self):
+            threading.Thread(target=self.loop, name="pump").start()
+    """}
+    a = render_failure_surface(build_failure_surface(_project(srcs)))
+    b = render_failure_surface(build_failure_surface(_project(srcs)))
+    assert a == b
+    assert json.loads(a)["counts"]["boundaries"] == 1
+
+
+def test_committed_manifest_matches_tree_byte_for_byte(fresh_surface):
+    with open(MANIFEST, "r", encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == render_failure_surface(fresh_surface), (
+        "FAILURE_SURFACE.json drifted — regenerate with `python -m "
+        "vilbert_multitask_tpu.analysis exc` and commit")
+
+
+def test_diff_reports_boundary_and_verdict_drift(fresh_surface):
+    msgs = diff_failure_surface(None, fresh_surface)
+    assert msgs and "missing" in msgs[0]
+    mutated = copy.deepcopy(fresh_surface)
+    b = next(x for x in mutated["boundaries"]
+             if x["name"] == "serve-worker")
+    b["verdict"] = "escapes"
+    b["escapes"] = {"RuntimeError": []}
+    msgs = diff_failure_surface(mutated, fresh_surface)
+    assert any("verdict drifted" in m for m in msgs)
+    assert any("escape set drifted" in m for m in msgs)
+    mutated = copy.deepcopy(fresh_surface)
+    mutated["boundaries"] = [x for x in mutated["boundaries"]
+                             if x["name"] != "serve-worker"]
+    msgs = diff_failure_surface(mutated, fresh_surface)
+    assert any("new in the tree" in m for m in msgs)
+    assert not diff_failure_surface(copy.deepcopy(fresh_surface),
+                                    fresh_surface)
+
+
+def test_sarif_rendering_carries_escape_flows(fresh_surface):
+    doc = json.loads(render_failure_surface_sarif(fresh_surface))
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "vmtlint-exc"
+    results = run["results"]
+    assert len(results) == len(fresh_surface["boundaries"])
+    flowing = [r for r in results if r.get("codeFlows")]
+    assert flowing, "no boundary carried a witness chain"
+    loc = flowing[0]["codeFlows"][0]["threadFlows"][0]["locations"][0]
+    assert loc["location"]["physicalLocation"]["region"]["startLine"] >= 1
+
+
+def test_exc_check_gate_is_clean(monkeypatch):
+    from vilbert_multitask_tpu.analysis.cli import main as cli_main
+
+    monkeypatch.chdir(REPO)
+    assert cli_main(["exc", "--check"]) == 0
+
+
+def test_exc_check_exits_nonzero_on_missing_manifest(monkeypatch,
+                                                     tmp_path):
+    from vilbert_multitask_tpu.analysis.cli import main as cli_main
+
+    monkeypatch.chdir(REPO)
+    assert cli_main(["exc", "--check",
+                     "--out", str(tmp_path / "nope.json")]) == 1

@@ -1,0 +1,107 @@
+"""Thread watchdog + crash guard: the runtime twin of the exc tier.
+
+A guarded loop that dies by exception must become VISIBLE — death
+filed in the registry, ``vmt_thread_alive{name}`` dropped, a
+``thread_died`` flight-recorder bundle on disk — and a restarted loop
+under the same name must self-heal the record. Exit exceptions are a
+shutdown, not a death, and must propagate.
+"""
+
+import json
+import threading
+
+import pytest
+
+from vilbert_multitask_tpu import obs
+from vilbert_multitask_tpu.obs.watchdog import THREAD_ALIVE_GAUGE
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    obs.watchdog().reset()
+    yield
+    obs.watchdog().reset()
+
+
+def test_clean_exit_retires_the_thread():
+    with obs.crash_guard("tick"):
+        assert obs.watchdog().alive_threads() == ["tick"]
+        assert THREAD_ALIVE_GAUGE.value(name="tick") == 1
+    assert obs.watchdog().alive_threads() == []
+    assert obs.watchdog().dead_threads() == {}
+    assert THREAD_ALIVE_GAUGE.value(name="tick") == 0
+    assert obs.watchdog().is_known_thread("tick")
+
+
+def test_exception_records_death_and_swallows():
+    with obs.crash_guard("pump"):
+        raise ValueError("boom")  # must NOT propagate
+    dead = obs.watchdog().dead_threads()
+    assert dead == {"pump": "ValueError: boom"}
+    assert THREAD_ALIVE_GAUGE.value(name="pump") == 0
+
+
+def test_exit_exceptions_propagate():
+    with pytest.raises(SystemExit):
+        with obs.crash_guard("pump"):
+            raise SystemExit(3)
+    # A shutdown is not a death.
+    assert "pump" not in obs.watchdog().dead_threads()
+
+
+def test_restart_under_same_name_self_heals():
+    with obs.crash_guard("pump"):
+        raise RuntimeError("first life")
+    assert "pump" in obs.watchdog().dead_threads()
+    with obs.crash_guard("pump"):
+        assert "pump" not in obs.watchdog().dead_threads()
+    assert obs.watchdog().dead_threads() == {}
+
+
+def test_guard_defaults_to_current_thread_name():
+    died = threading.Event()
+
+    def loop():
+        with obs.crash_guard():
+            raise KeyError("k")
+
+    t = threading.Thread(target=loop, name="fixture-loop", daemon=True)
+    t.start()
+    t.join(timeout=10)
+    died.set()
+    assert "fixture-loop" in obs.watchdog().dead_threads()
+    assert obs.watchdog().is_known_thread("fixture-loop")
+
+
+def test_silent_death_reconciled_by_probe_and_dead_threads():
+    t = threading.Thread(target=lambda: None, name="quiet", daemon=True)
+    t.start()
+    t.join(timeout=10)
+    # Adopt AFTER the thread finished: registered but never retired —
+    # the is_alive reconciliation must surface it without any raise.
+    obs.watchdog().adopt("quiet", t)
+    assert obs.watchdog().dead_threads() == {
+        "quiet": "thread no longer alive"}
+    series = obs.watchdog().probe()
+    assert series["thread_alive_quiet"] == 0.0
+    assert THREAD_ALIVE_GAUGE.value(name="quiet") == 0
+
+
+def test_death_writes_thread_died_bundle(tmp_path):
+    rec = obs.FlightRecorder(str(tmp_path), min_interval_s=0.0)
+    obs.install_recorder(rec)
+    try:
+        with obs.crash_guard("doomed"):
+            raise OSError("disk gone")
+        rec.close()
+        bundles = rec.bundles()
+        assert bundles, "no bundle captured for the death"
+        with open(bundles[-1]) as f:
+            b = json.load(f)
+        assert b["event"] == "thread_died"
+        assert b["detail"]["thread"] == "doomed"
+        assert b["detail"]["error_type"] == "OSError"
+        assert "disk gone" in b["detail"]["error"]
+        assert "traceback" in b["detail"]
+    finally:
+        obs.clear_recorder()

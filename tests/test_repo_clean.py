@@ -94,7 +94,8 @@ def test_whole_program_rules_active_and_scan_covers_tests():
             "VMT119", "VMT120", "VMT121", "VMT122", "VMT123",
             "VMT124", "VMT125", "VMT126", "VMT127",
             "VMT128", "VMT129", "VMT130", "VMT131",
-            "VMT132", "VMT133", "VMT134", "VMT135", "VMT136"} <= ids
+            "VMT132", "VMT133", "VMT134", "VMT135", "VMT136",
+            "VMT137", "VMT138", "VMT139", "VMT140"} <= ids
     assert cfg.layers, "[tool.vmtlint.layers] contracts disappeared"
     assert any(p == "tests" or p.startswith("tests/") for p in cfg.paths)
 

@@ -180,6 +180,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _txn_main(argv[1:])
     if argv and argv[0] == "proto":
         return _proto_main(argv[1:])
+    if argv and argv[0] == "exc":
+        return _exc_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for cls in RULES:
@@ -542,6 +544,94 @@ def _proto_main(argv: Sequence[str]) -> int:
           f"protocol(s), {fresh['counts']['acquire_sites']} acquire "
           f"site(s), {fresh['counts']['fault_points']} fault point(s) "
           f"to {out_path}", file=sys.stderr)
+    return 0
+
+
+def _exc_main(argv: Sequence[str]) -> int:
+    """``vmtlint exc [--check] [--out FILE] [--format json|sarif]``:
+    build the failure-surface manifest (every thread/tick/breaker/
+    fault-site boundary with its escaping exception set and verdict,
+    the handler inventory, the project exception taxonomy) and write,
+    print, or verify it — the FAILURE_SURFACE.json sibling of
+    ``surface``, ``txn``, and ``proto``.
+
+    Like ``proto`` this loads the *configured* paths, not just library
+    roots: boundaries and findings bind only library code, but the
+    escape summaries compose through everything the config scans."""
+    from vilbert_multitask_tpu.analysis import exc as exc_mod
+    from vilbert_multitask_tpu.analysis import surface as surf_mod
+
+    p = argparse.ArgumentParser(
+        prog="python -m vilbert_multitask_tpu.analysis exc",
+        description="Enumerate the exception-flow failure surface "
+                    "(thread entries, sampler ticks, breaker regions, "
+                    "fault sites — each with its escaping exception "
+                    "set and verdict), as FAILURE_SURFACE.json")
+    p.add_argument("--check", action="store_true",
+                   help="verify the committed manifest matches the tree; "
+                        "exit 1 on drift (the CI gate)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help=f"manifest path (default: <repo>/"
+                        f"{exc_mod.MANIFEST_NAME})")
+    p.add_argument("--format", default="json", dest="fmt",
+                   choices=("json", "sarif"),
+                   help="with no --check: 'json' writes the manifest, "
+                        "'sarif' prints boundary escape chains to "
+                        "stdout")
+    args = p.parse_args(argv)
+
+    cfg, root = load_config(os.getcwd())
+    root = root or os.getcwd()
+    roots = [os.path.join(root, r) for r in cfg.paths]
+    roots = [r for r in roots if os.path.exists(r)] or [root]
+    sources = {}
+    for path in iter_python_files(roots, exclude=cfg.exclude):
+        rel = os.path.relpath(os.path.abspath(path),
+                              root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    project = surf_mod.load_project(sources)
+    fresh = exc_mod.build_failure_surface(project)
+    out_path = args.out or os.path.join(root, exc_mod.MANIFEST_NAME)
+
+    if args.check:
+        committed = None
+        if os.path.exists(out_path):
+            try:
+                with open(out_path, "r", encoding="utf-8") as f:
+                    committed = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"vmtlint exc: unreadable manifest "
+                      f"{out_path}: {e}", file=sys.stderr)
+                return 2
+        msgs = exc_mod.diff_failure_surface(committed, fresh)
+        if msgs:
+            for m in msgs:
+                print(f"vmtlint exc: {m}", file=sys.stderr)
+            print("vmtlint exc: failure surface drifted — regenerate "
+                  "with `python -m vilbert_multitask_tpu.analysis "
+                  "exc` and commit the result", file=sys.stderr)
+            return 1
+        print(f"vmtlint exc: check clean — "
+              f"{fresh['counts']['boundaries']} boundary(ies), "
+              f"{fresh['counts']['escaping_boundaries']} escaping, "
+              f"{fresh['counts']['handlers']} handler(s), "
+              f"{fresh['counts']['exception_classes']} exception "
+              f"class(es)", file=sys.stderr)
+        return 0
+
+    if args.fmt == "sarif":
+        sys.stdout.write(exc_mod.render_failure_surface_sarif(fresh))
+        return 0
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(exc_mod.render_failure_surface(fresh))
+    print(f"vmtlint exc: wrote {fresh['counts']['boundaries']} "
+          f"boundary(ies) ({fresh['counts']['escaping_boundaries']} "
+          f"escaping), {fresh['counts']['handlers']} handler(s) to "
+          f"{out_path}", file=sys.stderr)
     return 0
 
 

@@ -1,0 +1,150 @@
+"""VMT137–140: exception-flow rules over the exc tier.
+
+Every prior tier proved a closed universe for compiles, transactions,
+and protocols; these rules close the last unproven plane — *failures*.
+The fleet runs ~10 daemon threads where an escaping exception kills the
+thread silently: the queue backs up, SLOs page late, and nothing names
+the culprit. :class:`analysis.exc.ExcFlow` precomputes, project-wide,
+the set of exception classes that can escape each function (raise-site
+inference, handler narrowing with tuple/alias resolution, per-function
+summaries composed through the call graph to a fixed point) and
+resolves every boundary to its escaping set — the same cached-flow
+consumption shape as the VMT132-135 protocol rules.
+
+All four are ``library_only``: tests raise and swallow on purpose.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from vilbert_multitask_tpu.analysis.context import ModuleContext
+from vilbert_multitask_tpu.analysis.core import Finding, Rule
+from vilbert_multitask_tpu.analysis.exc import exc_flow
+from vilbert_multitask_tpu.analysis.locks import _Anchor
+
+
+class ThreadRunLoopEscape(Rule):
+    """An exception type escapes a thread entry point.
+
+    A daemon thread that dies takes its loop with it and tells no one —
+    the interprocedural escape summary composed down from every reachable
+    ``raise`` proves which classes can surface at the entry, and the
+    raise→escape witness chain renders as SARIF codeFlows. The fix is
+    the runtime twin this tier proves complete: run the loop body under
+    ``obs.crash_guard`` so the death records a ``thread_died`` bundle,
+    drops ``vmt_thread_alive{name}``, and turns ``/healthz`` unready.
+    """
+
+    id = "VMT137"
+    name = "thread-run-loop-escape"
+    severity = "error"
+    library_only = True
+    description = ("an exception class escapes a thread entry point "
+                   "(Thread/Timer target or Thread-subclass run) — "
+                   "silent thread death: the loop stops and nothing "
+                   "records why")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = exc_flow(ctx.project)
+        for e in flow.thread_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            f = self.finding(ctx, _Anchor(e["line"], e["col"]),
+                             e["message"])
+            f.flows = [list(chain) for chain in e["flows"]]
+            yield f
+
+
+class BreakerBlindException(Rule):
+    """An escape the breaker's recording clause never observes.
+
+    A ``CircuitBreaker`` only protects against failures it *sees*:
+    a class re-raised via ``no_retry``, or escaping outside
+    ``retry_on`` / the manual ``record_failure`` handler's types, never
+    trips the breaker — a deterministic fault of that class loops at
+    full request rate while the breaker reports closed.
+    """
+
+    id = "VMT138"
+    name = "breaker-blind-exception"
+    severity = "error"
+    library_only = True
+    description = ("an exception escaping a CircuitBreaker-wrapped "
+                   "region that the breaker's recording clause does "
+                   "not observe — the breaker never trips on this "
+                   "failure class")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = exc_flow(ctx.project)
+        for e in flow.breaker_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            f = self.finding(ctx, _Anchor(e["line"], e["col"]),
+                             e["message"])
+            f.flows = [list(chain) for chain in e["flows"]]
+            yield f
+
+
+class HandlerShadowsTerminal(Rule):
+    """A broad handler swallows an exception on a path owing a terminal.
+
+    Composes with the protocol tier: between a ``claim``/``checkout``
+    and its terminal, a broad ``except`` that neither re-raises nor
+    reaches a terminal-bearing call silently converts a failure into a
+    leaked handle — the job sits invisible until the visibility sweep
+    redelivers it, which is exactly the class of latency bug the
+    exactly-one-terminal proof exists to prevent.
+    """
+
+    id = "VMT139"
+    name = "handler-shadows-terminal"
+    severity = "error"
+    library_only = True
+    description = ("a broad except swallows an exception while an "
+                   "acquired protocol handle still owes its terminal — "
+                   "the claim leaks until the visibility sweep")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = exc_flow(ctx.project)
+        for e in flow.shadow_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])
+
+
+class ErrorFrameDrift(Rule):
+    """Handler-emitted verdict strings cross-checked against vocabulary.
+
+    The txn tier recovers the ``jobs.status`` machine; the library's own
+    non-handler ``job_finish`` sites establish the verdict vocabulary on
+    top of it. A verdict string minted *inside an exception handler*
+    that matches neither is a failure class dashboards will drop on the
+    floor — with did-you-mean, because these are almost always
+    one-letter drift.
+    """
+
+    id = "VMT140"
+    name = "error-frame-drift"
+    severity = "warning"
+    library_only = True
+    description = ("an error/verdict string emitted from an exception "
+                   "handler that is not in the recovered jobs.status "
+                   "machine or the library's verdict vocabulary")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.project is None:
+            return
+        flow = exc_flow(ctx.project)
+        for e in flow.frame_findings:
+            if e["path"] != ctx.rel_path:
+                continue
+            yield self.finding(ctx, _Anchor(e["line"], e["col"]),
+                               e["message"])

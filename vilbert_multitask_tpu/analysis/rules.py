@@ -2161,6 +2161,9 @@ from vilbert_multitask_tpu.analysis.txnrules import (  # noqa: E402
 from vilbert_multitask_tpu.analysis.protorules import (  # noqa: E402
     FaultPointCoverage, JobTerminalProtocol, ResourceLeakOnException,
     TerminalFrameDrift)
+from vilbert_multitask_tpu.analysis.excrules import (  # noqa: E402
+    BreakerBlindException, ErrorFrameDrift, HandlerShadowsTerminal,
+    ThreadRunLoopEscape)
 
 RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BenchTimingHazard, StrayPrint, SqliteThreadSharing,
@@ -2174,7 +2177,8 @@ RULES = [HostTransferInJit, RecompileTrigger, DonatedBufferReuse,
          BucketShapeDrift, RmwDeferredTxn, MultiWriteNoTxn, SqlSchemaDrift,
          NondeterministicClaim, JobTerminalProtocol,
          ResourceLeakOnException, FaultPointCoverage, TerminalFrameDrift,
-         ExemplarCardinality]
+         ThreadRunLoopEscape, BreakerBlindException,
+         HandlerShadowsTerminal, ErrorFrameDrift, ExemplarCardinality]
 
 
 def default_rules(severity_overrides: Optional[Dict[str, str]] = None,

@@ -73,6 +73,12 @@ from vilbert_multitask_tpu.obs.recorder import (
     record_event,
     record_spike,
 )
+from vilbert_multitask_tpu.obs.watchdog import (
+    THREAD_ALIVE_GAUGE,
+    ThreadWatchdog,
+    crash_guard,
+    watchdog,
+)
 from vilbert_multitask_tpu.obs.slo import (
     STATE_OK,
     STATE_PAGE,
@@ -117,6 +123,7 @@ __all__ = [
     "SAMPLER_THREAD_NAME", "Sampler", "TimeSeriesStore",
     "RECORDER_THREAD_NAME", "FlightRecorder", "active_recorder",
     "clear_recorder", "install_recorder", "record_event", "record_spike",
+    "THREAD_ALIVE_GAUGE", "ThreadWatchdog", "crash_guard", "watchdog",
     "STATE_OK", "STATE_PAGE", "STATE_WARN", "Slo", "SloEvaluator",
     "availability_slo", "latency_slo", "slack_floor_slo",
     "WorkerIdentity", "mint_identity", "process_identity",

@@ -345,6 +345,10 @@ class ServeApp:
             sum(m.latency.series_counts().values()))
         vals["failures_total"] = float(m.failure_events.count())
         vals.update(self.engine.live_stats())
+        # Thread-liveness reconciliation: republishes vmt_thread_alive
+        # for every guarded loop, so a crash-guarded death (or a silent
+        # one) is visible in /healthz within one sampler cadence.
+        vals.update(obs.watchdog().probe())
         # Scheduler plane (empty dict while the legacy loop runs): ready
         # depth, adaptive window, and *_total dispatch counters.
         vals.update(self.worker.scheduler_stats())
@@ -429,6 +433,15 @@ class ServeApp:
         self.boot_info["last_swap"] = report
         return report
 
+    def _run_worker(self) -> None:
+        """Thread entry for the in-process worker. The crash guard lives
+        HERE, not in ``run_forever``: remote deployments call
+        ``run_forever`` synchronously from their own main thread and must
+        see exceptions, while this daemon thread's only observer is the
+        watchdog."""
+        with obs.crash_guard("serve-worker"):
+            self.worker.run_forever(stop_event=self._stop)
+
     def start(self, worker: bool = True) -> None:
         """Boot the tiers; ``worker=False`` serves HTTP/ws only (an external
         worker — serve/remote.py, or the chaos soak's scripted one — drains
@@ -472,8 +485,7 @@ class ServeApp:
         self.engine.mark_ready()
         if worker:
             self._worker_thread = threading.Thread(
-                target=self.worker.run_forever,
-                kwargs={"stop_event": self._stop},
+                target=self._run_worker,
                 daemon=True, name="serve-worker")
             self._worker_thread.start()
         self.sampler.start()

@@ -229,7 +229,13 @@ class ApiServer:
         # killed replica shows up here within one sampler cadence.
         no_replica = (self.pool is not None
                       and self.pool.ready_count() == 0)
-        ready = not booting and not paging and not no_replica
+        # Watchdog: any crash-guarded thread that died (by exception or
+        # silently) makes the replica unready — a worker with no intake
+        # threads drains nothing, whatever the pool says.
+        wd = obs.watchdog()
+        dead = wd.dead_threads()
+        ready = (not booting and not paging and not no_replica
+                 and not dead)
         body: Dict[str, Any] = {
             "ok": ready,
             "identity": obs.process_identity().as_dict(),
@@ -237,14 +243,18 @@ class ApiServer:
             "boot": self.boot_info,
             "breakers": breakers,
             "slo": slo_states,
+            "threads": {"alive": wd.alive_threads(), "dead": dead},
         }
         if self.pool is not None:
             body["replicas"] = self.pool.replicas_info()
             body["ready_replicas"] = self.pool.ready_count()
         if not ready:
-            body["reason"] = ("booting" if booting
-                              else "no_ready_replica" if no_replica
-                              else f"slo_page:{','.join(paging)}")
+            body["reason"] = (
+                "booting" if booting
+                else "no_ready_replica" if no_replica
+                else f"thread_died:{','.join(sorted(dead))}" if (
+                    dead and not paging)
+                else f"slo_page:{','.join(paging)}")
         return (200 if ready else 503), body
 
     def refresh_gauges(self) -> None:

@@ -196,7 +196,17 @@ class ContinuousScheduler:
         the durable queue, so they keep counting against the HTTP door's
         AdmissionController depth (pending + inflight); the knob bounds
         claim run-ahead, it does not bypass admission.
+
+        Runs under :func:`obs.crash_guard`: the exc tier proved the
+        claim at the top of this loop sits OUTSIDE the intake
+        try/except, so an injected ``queue.claim`` fault (or any remote
+        transport error) would kill the thread silently. The guard
+        records a ``thread_died`` bundle and flips ``/healthz`` instead.
         """
+        with obs.crash_guard(threading.current_thread().name):
+            self._intake_pump()
+
+    def _intake_pump(self) -> None:
         while not self.stop.is_set():
             with self._cond:
                 backlog = len(self._ready)
@@ -408,7 +418,16 @@ class ContinuousScheduler:
     # ---------------------------------------------------- completion stage
     def _completion_loop(self) -> None:
         """Persist + push off the dispatch thread, so the next batch's
-        forward overlaps this batch's DB writes and websocket frames."""
+        forward overlaps this batch's DB writes and websocket frames.
+
+        Guarded like the intake loop: ``_fail_job`` in the except arm
+        reaches the queue's nack (remote transport in split deploys), so
+        even the recovery path can raise — the guard makes that death
+        loud instead of stranding every future completion."""
+        with obs.crash_guard(threading.current_thread().name):
+            self._completion_pump()
+
+    def _completion_pump(self) -> None:
         while True:
             msg = self._completions.get()
             if msg is None:

@@ -152,6 +152,21 @@ echo "== failover smoke (replica pool: seeded kill, exactly-one-terminal) =="
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --replicas 2 --dryrun \
   --kill-replica --seed 7 --jobs 40 --out /tmp/POOL_SOAK.json || fail=1
 
+echo "== zipf smoke (result cache, coalescing, swap invalidation) =="
+# Duplicate-traffic soak: one leader + attached followers collapse to one
+# forward, cached hits answer inline at >=10x the forward path's qps, a
+# rolling swap turns every warmed key back into a miss, and the device-s
+# conservation ledger stays EXACTLY 1.0 with hits/followers in the mix.
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --zipf --jobs 48 \
+  --out /tmp/ZIPF_SOAK.json || fail=1
+
+echo "== zipf chaos smoke (coalesced leader dies, followers still close) =="
+# Same burst, but a seeded worker.intake fault plan dead-letters the
+# coalesced leader: every one of the N identical submits must still reach
+# exactly one terminal frame (the dead-letter fan-out).
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --zipf --chaos --jobs 48 \
+  --seed 3 --out /tmp/ZIPF_CHAOS_SOAK.json || fail=1
+
 echo "== quant smoke (int8 storage parity + roofline-knee plumbing) =="
 # Tiny f32 vs int8 engine: quantized tree reads <0.35x the bytes, one
 # task per decode family stays within quantization noise through the

@@ -156,10 +156,13 @@ def main(argv=None) -> int:
     report["failures"] = failures
     report["verdict"] = verdict
     try:
+        from vilbert_multitask_tpu.config import config_fingerprint
+
         obs.ledger_append(
             "fleet.smoke",
             {"boot_s": report["boot_s"],
              "fleet_query_ms": report.get("fleet_query_ms", 0.0)},
+            config_fingerprint=config_fingerprint(cfg),
             extra={"verdict": "pass" if verdict else "fail"})
     except Exception as e:
         print(f"# perf-ledger append skipped: {e}", file=sys.stderr)

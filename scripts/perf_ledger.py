@@ -82,7 +82,17 @@ def cmd_check(args) -> int:
 
 def cmd_append(args) -> int:
     values = _parse_kv(args.values)
-    entry = ledger.append_entry(args.metric, values, path=args.path)
+    # CLI appends (check.sh's lint-wall entry) stamp the default config's
+    # fingerprint: baselines must never mix entries from different configs
+    # under a null fingerprint.
+    from vilbert_multitask_tpu.config import (
+        FrameworkConfig,
+        config_fingerprint,
+    )
+
+    entry = ledger.append_entry(
+        args.metric, values, path=args.path,
+        config_fingerprint=config_fingerprint(FrameworkConfig()))
     print(json.dumps(entry, sort_keys=True))
     return 0
 

@@ -1,0 +1,429 @@
+"""Result cache, singleflight coalescing, and the tenant-weighted EDF tier.
+
+The cache/coalescing unit tests drive :class:`ResultCache` directly on a
+tmp sqlite file — claim-state transitions, lease takeover, swap
+invalidation, exactly-once follower pops. The deficit-scheduler tests
+drive ``select_batch`` with fabricated items and explicit clocks, same
+style as test_scheduler.py. The integration tests run real submits
+through ``ApiServer.submit_job`` + ``ServeWorker`` and assert the
+tentpole invariant: N identical concurrent submits → ONE forward, and
+exactly one terminal frame per submit even when the leader dead-letters
+or expires (seeded FaultPlan, no sleep-based races).
+"""
+
+import queue as queue_mod
+import threading
+import time
+
+import pytest
+
+from vilbert_multitask_tpu import obs
+from vilbert_multitask_tpu.serve.resultcache import (
+    ResultCache,
+    cache_key,
+    canonical_question,
+)
+from vilbert_multitask_tpu.serve.scheduler import ReadyItem, select_batch
+
+
+# ---------------------------------------------------------------- cache key
+def test_cache_key_canonicalizes_whitespace(tmp_path):
+    img = str(tmp_path / "img_a.npy")
+    k1 = cache_key(1, [img], "what  is\tthis ", "fp")
+    k2 = cache_key(1, [img], "what is this", "fp")
+    assert k1 == k2
+    assert canonical_question("  a\t b \n") == "a b"
+
+
+def test_cache_key_separates_task_images_question_fingerprint(tmp_path):
+    img = str(tmp_path / "img_a.npy")
+    base = cache_key(1, [img], "q", "fp")
+    assert cache_key(2, [img], "q", "fp") != base
+    assert cache_key(1, [img, img], "q", "fp") != base
+    assert cache_key(1, [img], "q2", "fp") != base
+    assert cache_key(1, [img], "q", "fp2") != base
+
+
+def test_cache_key_tracks_file_content_identity(tmp_path):
+    """The image component is file+mtime+size (features/store.py identity
+    idiom): overwriting the file must rotate the key, a missing file
+    degrades to the raw path (still a stable key)."""
+    img = tmp_path / "img.npy"
+    missing = cache_key(1, [str(img)], "q", "fp")
+    assert missing == cache_key(1, [str(img)], "q", "fp")
+    img.write_bytes(b"one")
+    k1 = cache_key(1, [str(img)], "q", "fp")
+    assert k1 != missing
+    time.sleep(0.01)  # mtime_ns tick
+    img.write_bytes(b"two bytes longer")
+    assert cache_key(1, [str(img)], "q", "fp") != k1
+
+
+# ------------------------------------------------------------ claim machine
+@pytest.fixture()
+def cache(tmp_path):
+    return ResultCache(str(tmp_path / "cache.sqlite3"), fingerprint="fp1")
+
+
+def test_claim_lead_attach_hit_lifecycle(cache):
+    key = cache_key(1, ["a"], "q", cache.fingerprint)
+    # First submit leads...
+    assert cache.admit(key, socket_id="s0") == ("lead", None)
+    cache.set_leader(key, 41)
+    # ...identical in-flight submits attach to the leader's job id...
+    state, leader = cache.admit(key, socket_id="s1", trace_id="t1")
+    assert (state, leader) == ("attach", 41)
+    # ...completion makes every later submit a durable hit.
+    cache.complete(key, {"answers": [1]})
+    state, payload = cache.admit(key, socket_id="s2")
+    assert state == "hit" and payload == {"answers": [1]}
+    assert cache.stats()["cache_stored_hits"] == 1.0
+
+
+def test_claim_coalesce_disabled_leads_without_attaching(cache):
+    key = cache_key(1, ["a"], "q", cache.fingerprint)
+    assert cache.admit(key, socket_id="s0")[0] == "lead"
+    # coalesce off: the duplicate runs its own forward (no follower row),
+    # but completed results still hit.
+    assert cache.admit(key, socket_id="s1", coalesce=False) == \
+        ("lead", None)
+    assert cache.peek_followers(key) == []
+    cache.complete(key, {"v": 2})
+    assert cache.admit(key, socket_id="s2", coalesce=False)[0] == "hit"
+
+
+def test_lease_takeover_rearms_dead_leader(tmp_path):
+    """A leader that died without completing must not strand the key:
+    past the lease, the next submit takes leadership over."""
+    c = ResultCache(str(tmp_path / "c.sqlite3"), fingerprint="fp",
+                    lease_s=0.0)
+    key = cache_key(1, ["a"], "q", c.fingerprint)
+    assert c.admit(key, socket_id="s0")[0] == "lead"
+    c.set_leader(key, 7)
+    # Lease already expired (lease_s=0): re-arm instead of attaching to
+    # the corpse. Earlier followers stay registered for the new leader.
+    assert c.admit(key, socket_id="s1")[0] == "lead"
+
+
+def test_complete_does_not_resurrect_invalidated_row(cache):
+    key = cache_key(1, ["a"], "q", cache.fingerprint)
+    cache.admit(key, socket_id="s0")
+    # Rolling swap lands while the leader is in flight.
+    assert cache.invalidate("fp2") == 1
+    cache.complete(key, {"stale": True})
+    # The old-generation payload must NOT be served under the new gen.
+    newkey = cache_key(1, ["a"], "q", cache.fingerprint)
+    assert cache.admit(newkey, socket_id="s1")[0] == "lead"
+    assert cache.stats()["cache_done_rows"] == 0.0
+
+
+def test_ttl_expired_entry_leads_again(tmp_path):
+    c = ResultCache(str(tmp_path / "c.sqlite3"), fingerprint="fp",
+                    ttl_s=0.0)
+    key = cache_key(1, ["a"], "q", c.fingerprint)
+    c.admit(key, socket_id="s0")
+    c.complete(key, {"v": 1})
+    # ttl 0: the done row is stale on arrival — dropped, fresh lead.
+    assert c.admit(key, socket_id="s1")[0] == "lead"
+
+
+def test_pop_followers_is_destructive_peek_is_not(cache):
+    key = cache_key(1, ["a"], "q", cache.fingerprint)
+    cache.admit(key, socket_id="s0")
+    cache.admit(key, socket_id="s1", trace_id="t1", tenant="gold")
+    cache.admit(key, socket_id="s2", trace_id="t2")
+    peeked = cache.peek_followers(key)
+    assert [f.socket_id for f in peeked] == ["s1", "s2"]
+    assert peeked[0].tenant == "gold" and peeked[0].trace_id == "t1"
+    popped = cache.pop_followers(key)
+    assert [f.socket_id for f in popped] == ["s1", "s2"]
+    # Exactly-once: a racing second terminal pops an empty registry.
+    assert cache.pop_followers(key) == []
+
+
+def test_invalidate_drops_only_other_generations(cache):
+    k_old = cache_key(1, ["a"], "q", cache.fingerprint)
+    cache.admit(k_old, socket_id="s0")
+    cache.complete(k_old, {"v": 1})
+    dropped = cache.invalidate("fp2")
+    assert dropped == 1 and cache.fingerprint == "fp2"
+    k_new = cache_key(1, ["a"], "q", "fp2")
+    cache.admit(k_new, socket_id="s1")
+    cache.complete(k_new, {"v": 2})
+    # Same fingerprint: nothing to drop.
+    assert cache.invalidate("fp2") == 0
+    assert cache.admit(k_new, socket_id="s2")[0] == "hit"
+
+
+def test_abandon_lets_next_submit_retry(cache):
+    key = cache_key(1, ["a"], "q", cache.fingerprint)
+    cache.admit(key, socket_id="s0")
+    cache.abandon(key)
+    assert cache.admit(key, socket_id="s1")[0] == "lead"
+
+
+def test_capacity_trim_keeps_newest(tmp_path):
+    c = ResultCache(str(tmp_path / "c.sqlite3"), fingerprint="fp",
+                    max_rows=2)
+    keys = [cache_key(1, ["a"], f"q{i}", "fp") for i in range(4)]
+    for k in keys:
+        c.admit(k, socket_id="s")
+        c.complete(k, {"k": k})
+    assert c.stats()["cache_done_rows"] == 2.0
+    # Newest survive, oldest evicted back to a miss.
+    assert c.admit(keys[-1], socket_id="s")[0] == "hit"
+    assert c.admit(keys[0], socket_id="s")[0] == "lead"
+
+
+# ------------------------------------------------- tenant-weighted packing
+def _Req(n):
+    class R:
+        n_images = n
+    return R()
+
+
+def _titem(tenant, expiry=None, enq_t=0.0, n=1):
+    from vilbert_multitask_tpu.resilience import Deadline
+
+    dl = None
+    if expiry is not None:
+        dl = Deadline(1.0)
+        dl._expires_perf = expiry  # explicit clock, test_scheduler.py style
+    return ReadyItem(None, 1, _Req(n), 0.0, dl, enq_t, tenant=tenant)
+
+
+def test_select_batch_without_deficits_is_pure_edf():
+    items = [_titem("a", expiry=103.0), _titem("b", expiry=101.0),
+             _titem("a", expiry=102.0)]
+    batch, expired, rest = select_batch(items, now=100.0, max_rows=2)
+    assert [i.deadline.expires_at() for i in batch] == [101.0, 102.0]
+    assert not expired and len(rest) == 1
+
+
+def test_select_batch_weighted_deficit_shares():
+    """3:1 weights → a 4-row fire packs 3 of gold's jobs and 1 of
+    bronze's, even with every deadline equal."""
+    items = [_titem("gold") for _ in range(8)] \
+        + [_titem("bronze") for _ in range(8)]
+    deficits = {}
+    batch, _, rest = select_batch(
+        items, now=100.0, max_rows=4, deficits=deficits,
+        weights={"gold": 3.0, "bronze": 1.0})
+    packed = [i.tenant for i in batch]
+    assert packed.count("gold") == 3 and packed.count("bronze") == 1
+    assert len(rest) == 12
+
+
+def test_select_batch_deficit_carries_over_to_starved_tenant():
+    """An underweighted tenant's unspent credit accumulates: it cannot be
+    starved forever by a heavier tenant's backlog."""
+    deficits = {}
+    weights = {"gold": 7.0, "bronze": 1.0}
+    served = {"gold": 0, "bronze": 0}
+    items = [_titem("gold") for _ in range(64)] \
+        + [_titem("bronze") for _ in range(8)]
+    for _ in range(8):
+        batch, _, items = select_batch(
+            items, now=100.0, max_rows=4, deficits=deficits,
+            weights=weights)
+        for it in batch:
+            served[it.tenant] += 1
+    assert served["bronze"] >= 2  # 1/8 of 32 rows, credit-carried
+    assert served["gold"] > served["bronze"]
+
+
+def test_select_batch_marks_passed_over_items_deferred():
+    items = [_titem("gold") for _ in range(4)] \
+        + [_titem("bronze") for _ in range(4)]
+    batch, _, rest = select_batch(
+        items, now=100.0, max_rows=2, deficits={},
+        weights={"gold": 1.0, "bronze": 1.0})
+    assert all(i.deferred for i in rest)
+    assert not any(i.deferred for i in batch)
+
+
+def test_select_batch_drained_tenant_resets_deficit():
+    """Cardinality bound: a tenant whose backlog fully drains leaves the
+    deficit map (no unbounded per-tenant state, no banked credit)."""
+    deficits = {}
+    items = [_titem("gold"), _titem("bronze")]
+    batch, _, rest = select_batch(
+        items, now=100.0, max_rows=4, deficits=deficits,
+        weights={"gold": 1.0, "bronze": 1.0})
+    assert len(batch) == 2 and not rest
+    assert deficits == {}
+
+
+def test_select_batch_expired_still_shed_first():
+    items = [_titem("gold", expiry=99.0), _titem("gold", expiry=200.0)]
+    batch, expired, rest = select_batch(
+        items, now=100.0, max_rows=4, deficits={}, weights={})
+    assert len(expired) == 1 and expired[0].deadline.expires_at() == 99.0
+    assert len(batch) == 1 and not rest
+
+
+# ----------------------------------------------------- end-to-end coalesce
+@pytest.fixture()
+def coalesce_stack(tiny_framework_cfg, engine, tmp_path):
+    """stack fixture + the duplicate-traffic tier wired through, the way
+    ServeApp composes it (one sqlite for queue + cache)."""
+    import dataclasses
+
+    from vilbert_multitask_tpu.serve import (
+        DurableQueue,
+        PushHub,
+        ResultStore,
+        ServeWorker,
+    )
+    from vilbert_multitask_tpu.serve.http_api import ApiServer
+
+    s = dataclasses.replace(
+        tiny_framework_cfg.serving,
+        queue_db_path=str(tmp_path / "q.sqlite3"),
+        results_db_path=str(tmp_path / "r.sqlite3"),
+        media_root=str(tmp_path / "media"),
+    )
+    hub = PushHub()
+    q = DurableQueue(s.queue_db_path,
+                     max_delivery_attempts=s.max_delivery_attempts)
+    store = ResultStore(s.results_db_path)
+    cache = ResultCache(s.queue_db_path, fingerprint="test-gen0",
+                        lease_s=60.0)
+    worker = ServeWorker(engine, q, store, hub, s, cache=cache)
+    api = ApiServer(q, store, hub, s, cache=cache)
+    return s, hub, q, store, worker, api, cache
+
+
+def _submit_n(api, hub, n, question="what is this", image="img_a.jpg"):
+    """N identical concurrent submits from N sockets; returns the per-
+    socket subscriptions and the api responses in socket order."""
+    subs = [hub.subscribe(f"co-{i}") for i in range(n)]
+    results: list = [None] * n
+
+    def _go(i):
+        results[i] = api.submit_job({
+            "task_id": 1, "socket_id": f"co-{i}", "question": question,
+            "image_list": [image], "tenant": "gold" if i % 2 else "bronze",
+        })
+
+    threads = [threading.Thread(target=_go, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert all(code == 200 for code, _ in results), results
+    return subs, [body for _, body in results]
+
+
+def _terminals(sub):
+    """Drain one socket's frames; return its terminal frames."""
+    out = []
+    while True:
+        try:
+            frame = sub.get_nowait()
+        except queue_mod.Empty:
+            return out
+        if ("result" in frame or "error" in frame
+                or frame.get("deadline_exceeded")
+                or frame.get("dead_letter")):
+            out.append(frame)
+
+
+def test_concurrent_identical_submits_one_forward_one_terminal_each(
+        coalesce_stack):
+    s, hub, q, store, worker, api, cache = coalesce_stack
+    subs, bodies = _submit_n(api, hub, 4)
+    markers = sorted(b.get("cache") for b in bodies)
+    # Exactly one submit led (published the one real job); the other
+    # three attached to its job id.
+    assert markers == ["coalesced", "coalesced", "coalesced", "miss"]
+    leader_id = next(b["job_id"] for b in bodies
+                     if b.get("cache") == "miss")
+    # A follower that attached before the leader's publish stamped the
+    # job id reports job_id null — fan-out is keyed on the cache key, so
+    # its terminal still closes. No follower may name a DIFFERENT job.
+    assert all(b["job_id"] in (leader_id, None) for b in bodies)
+    assert q.counts()["pending"] == 1  # ONE forward for four submits
+    worker.step_batch()
+    for sub in subs:
+        terms = _terminals(sub)
+        assert len(terms) == 1 and "result" in terms[0]
+    # The write-through makes submit five a durable hit, result inline.
+    code, body = api.submit_job({
+        "task_id": 1, "socket_id": "late", "question": "what is this",
+        "image_list": ["img_a.jpg"]})
+    assert code == 200 and body["cache"] == "hit"
+    assert body["result"]["question"] == "what is this"
+    assert q.counts().get("pending", 0) == 0
+
+
+def test_leader_dead_letter_fans_exactly_one_terminal_per_submit(
+        coalesce_stack):
+    """Satellite chaos proof, dead-letter arm: a seeded FaultPlan kills
+    every intake of the leader job until the queue quarantines it — all
+    N submits must still close with exactly one (error) terminal."""
+    from vilbert_multitask_tpu.resilience import (
+        FaultPlan,
+        FaultRule,
+        clear_plan,
+        install_plan,
+    )
+
+    s, hub, q, store, worker, api, cache = coalesce_stack
+    subs, bodies = _submit_n(api, hub, 3, question="doomed leader")
+    assert sorted(b.get("cache") for b in bodies) == \
+        ["coalesced", "coalesced", "miss"]
+    install_plan(FaultPlan(11, [
+        FaultRule("worker.intake", "error", rate=1.0, max_injections=32),
+    ]))
+    try:
+        for _ in range(s.max_delivery_attempts + 1):
+            worker.step_batch()
+    finally:
+        clear_plan()
+    assert q.counts()["dead"] == 1
+    for sub in subs:
+        terms = _terminals(sub)
+        assert len(terms) == 1, terms
+        assert "error" in terms[0]
+    # The singleflight claim dropped with the corpse: a retry submit
+    # republishes instead of attaching to the dead job.
+    code, body = api.submit_job({
+        "task_id": 1, "socket_id": "retry", "question": "doomed leader",
+        "image_list": ["img_a.jpg"]})
+    assert code == 200 and body["cache"] == "miss"
+
+
+def test_leader_expiry_fans_exactly_one_terminal_per_submit(
+        coalesce_stack):
+    """Deadline arm: the leader expires before dispatch — every follower
+    hears the deadline push, exactly once."""
+    s, hub, q, store, worker, api, cache = coalesce_stack
+    subs, bodies = _submit_n(api, hub, 3, question="too late")
+    assert sorted(b.get("cache") for b in bodies) == \
+        ["coalesced", "coalesced", "miss"]
+    job = q.claim()
+    worker._expire_job(job)
+    for sub in subs:
+        terms = _terminals(sub)
+        assert len(terms) == 1, terms
+        assert terms[0].get("deadline_exceeded")
+    # tenant_budget sheds classify separately in vmt_shed_total.
+    before = obs.SHED_COUNTER.value(reason="tenant_budget")
+    subs2, _ = _submit_n(api, hub, 1, question="qos shed")
+    worker._expire_job(q.claim(), reason="tenant_budget")
+    assert obs.SHED_COUNTER.value(reason="tenant_budget") == before + 1
+    assert _terminals(subs2[0])[0].get("deadline_exceeded")
+
+
+def test_attention_submits_bypass_the_cache(coalesce_stack):
+    """Per-request attention payloads are per-submit state: they must
+    never be served from (or stored into) the shared cache."""
+    s, hub, q, store, worker, api, cache = coalesce_stack
+    body = {"task_id": 1, "socket_id": "att", "question": "maps please",
+            "image_list": ["img_a.jpg"], "collect_attention": True}
+    code, b1 = api.submit_job(dict(body))
+    code2, b2 = api.submit_job(dict(body))
+    assert code == code2 == 200
+    assert "cache" not in b1 and "cache" not in b2
+    assert q.counts()["pending"] == 2  # no dedup across attention jobs

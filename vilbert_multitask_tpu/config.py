@@ -562,6 +562,30 @@ class ServingConfig:
     tracestore_keep_top_k: int = 8
     tracestore_sample_rate: float = 0.05
     tracestore_retention_s: float = 3600.0
+    # --- duplicate-traffic tier (serve/resultcache.py; ROADMAP item 3) ---
+    # Durable result cache: a WAL-sqlite table next to the jobs table
+    # (same db file), keyed on (task, feature-content hash, canonical
+    # question, config fingerprint/model generation). Hits skip the
+    # queue and TPU entirely; a rolling swap bumps the model generation
+    # and invalidates.
+    result_cache_enabled: bool = True
+    result_cache_max_rows: int = 4096
+    result_cache_ttl_s: float = 3600.0
+    # In-flight coalescing (singleflight): concurrent identical submits
+    # attach as followers to the one in-flight leader job; every
+    # terminal frame fans out to all followers. The lease bounds how
+    # long a dead leader can strand its key before a fresh submit takes
+    # the claim over and republishes.
+    coalesce_enabled: bool = True
+    coalesce_lease_s: float = 120.0
+    # Tenant-weighted fairness in the EDF scheduler: select_batch grants
+    # per-tenant row budgets by weighted deficit (DRR) ABOVE deadline
+    # ordering, so one hot tenant cannot starve the rest. Weights are
+    # relative shares; tenants absent from the map get the default
+    # weight, and None weights means every tenant is equal.
+    tenant_fairness_enabled: bool = True
+    tenant_weights: Mapping[str, float] | None = None
+    tenant_default_weight: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -120,6 +120,8 @@ __all__ = [
     "SHED_COUNTER", "RETRY_COUNTER", "BREAKER_GAUGE", "DEADLINE_SLACK",
     "BATCH_FILL", "SCHED_WAIT", "QUEUE_WAIT", "BATCHES_DISPATCHED",
     "REPLICA_STATE", "FAILOVER_COUNTER", "POISON_COUNTER",
+    "RESULT_CACHE_HITS", "RESULT_CACHE_MISSES",
+    "RESULT_CACHE_INVALIDATIONS", "COALESCED_SUBMITS", "TENANT_DEFICIT",
     "SAMPLER_THREAD_NAME", "Sampler", "TimeSeriesStore",
     "RECORDER_THREAD_NAME", "FlightRecorder", "active_recorder",
     "clear_recorder", "install_recorder", "record_event", "record_spike",
@@ -179,8 +181,10 @@ SCHED_WAIT = REGISTRY.histogram(
 QUEUE_WAIT = REGISTRY.histogram(
     "vmt_queue_wait_ms",
     "Publish-to-claim latency (ms): POST / stamp to worker claim, the "
-    "queueing delay Metrics.record's intake-anchored e2e cannot see.",
-    labelnames=("task",),
+    "queueing delay Metrics.record's intake-anchored e2e cannot see. "
+    "The tenant label is the deficit scheduler's user-facing effect: a "
+    "tenant throttled below its weighted share queues longer, visibly.",
+    labelnames=("task", "tenant"),
 )
 BATCHES_DISPATCHED = REGISTRY.counter(
     "vmt_batches_dispatched_total",
@@ -204,6 +208,34 @@ POISON_COUNTER = REGISTRY.counter(
     "vmt_poison_jobs_total",
     "Jobs dead-lettered by the queue after exhausting queue_max_deliveries "
     "total deliveries (poison-job quarantine).",
+)
+
+# Duplicate-traffic tier instruments (serve/resultcache.py + scheduler).
+RESULT_CACHE_HITS = REGISTRY.counter(
+    "vmt_result_cache_hits_total",
+    "Submits answered from the durable result cache — no queue publish, "
+    "no TPU forward.",
+)
+RESULT_CACHE_MISSES = REGISTRY.counter(
+    "vmt_result_cache_misses_total",
+    "Submits that missed the result cache and published a real job "
+    "(the submit became the singleflight leader).",
+)
+RESULT_CACHE_INVALIDATIONS = REGISTRY.counter(
+    "vmt_result_cache_invalidations_total",
+    "Cache rows dropped because a rolling swap changed the config "
+    "fingerprint / model generation.",
+)
+COALESCED_SUBMITS = REGISTRY.counter(
+    "vmt_coalesced_submits_total",
+    "Submits attached as followers to an identical in-flight job "
+    "(singleflight): they pay one shared forward instead of N.",
+)
+TENANT_DEFICIT = REGISTRY.gauge(
+    "vmt_tenant_deficit",
+    "Weighted-deficit scheduler credit per tenant (rows); persistently "
+    "negative means the tenant is consuming above its weighted share.",
+    labelnames=("tenant",),
 )
 
 

@@ -14,7 +14,7 @@ import dataclasses
 import os
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import FrameworkConfig, config_fingerprint
@@ -25,6 +25,7 @@ from vilbert_multitask_tpu.serve.http_api import ApiServer
 from vilbert_multitask_tpu.serve.pool import ReplicaPool
 from vilbert_multitask_tpu.serve.push import PushHub, WebSocketBridge
 from vilbert_multitask_tpu.serve.queue import DurableQueue
+from vilbert_multitask_tpu.serve.resultcache import ResultCache
 from vilbert_multitask_tpu.serve.worker import ServeWorker
 
 _FLEET_FLUSH_ERRORS = obs.REGISTRY.counter(
@@ -188,8 +189,25 @@ class ServeApp:
             self.engine = ReplicaPool(engines, serving=s)
         self.boot_info["replicas"] = [r.name for r in self.engine.replicas]
         self._refresh_boot_phases()
+        self.fingerprint = config_fingerprint(self.cfg)
+        # Result cache + singleflight registry: a second table pair in the
+        # SAME WAL sqlite as the jobs queue (one db to mount, one recovery
+        # story). Keyed on (task, image identity, canonical question,
+        # fingerprint:generation) — a rolling swap bumps model_gen so every
+        # pre-swap entry turns stale atomically. Coalescing rides the cache
+        # (followers attach to the leader's cache row), so coalesce without
+        # the cache is unsupported by construction.
+        self.model_gen = 0
+        self.cache: Optional[ResultCache] = None
+        if s.result_cache_enabled:
+            self.cache = ResultCache(
+                s.queue_db_path,
+                fingerprint=self._cache_fingerprint(),
+                max_rows=s.result_cache_max_rows,
+                ttl_s=s.result_cache_ttl_s,
+                lease_s=s.coalesce_lease_s)
         self.worker = ServeWorker(self.engine, self.queue, self.store,
-                                  self.hub, s)
+                                  self.hub, s, cache=self.cache)
         # Live-health plane (obs/): the time-series store + sampler, the
         # SLO evaluator, and the flight recorder. Built here so /debug/slo
         # and /healthz see them from the first request; the sampler thread
@@ -202,7 +220,6 @@ class ServeApp:
         self.slos = self._build_slos()
         self.sampler = obs.Sampler(self.timeseries, self._sample,
                                    cadence_s=s.sampler_cadence_s)
-        self.fingerprint = config_fingerprint(self.cfg)
         # Fleet observability: this process's identity plus its handle on
         # the shared metrics spine (a WAL sqlite next to the queue db).
         # Every sampler tick flushes instruments/timeseries/spans/heartbeat
@@ -255,7 +272,8 @@ class ServeApp:
             stats_fn=lambda: {"input_cache": self.engine.input_cache_stats},
             slos=self.slos, timeseries=self.timeseries,
             pool=self.engine, swap_fn=self.rolling_swap, fleet=self.fleet,
-            attrib=self.attrib, tracestore=self.tracestore)
+            attrib=self.attrib, tracestore=self.tracestore,
+            cache=self.cache)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
@@ -352,6 +370,30 @@ class ServeApp:
         # Scheduler plane (empty dict while the legacy loop runs): ready
         # depth, adaptive window, and *_total dispatch counters.
         vals.update(self.worker.scheduler_stats())
+        # Result-cache plane: row/follower depths plus the three cache
+        # counters (the sampler derives hit/miss/coalesce rates from the
+        # *_total keys — the zipf soak's gates read those).
+        if self.cache is not None:
+            vals.update(self.cache.stats())
+            vals["result_cache_hits_total"] = sum(
+                obs.RESULT_CACHE_HITS.collect().values())
+            vals["result_cache_misses_total"] = sum(
+                obs.RESULT_CACHE_MISSES.collect().values())
+            vals["coalesced_submits_total"] = sum(
+                obs.COALESCED_SUBMITS.collect().values())
+        # Per-tenant queueing delay (publish→claim p50), the deficit
+        # scheduler's user-facing effect: a tenant throttled below its
+        # weighted share queues longer, and that shows up HERE before it
+        # shows up as sheds. Label sets merge across tasks per tenant.
+        by_tenant: Dict[str, list] = {}
+        for key in obs.QUEUE_WAIT.series_counts():
+            task, tenant = key
+            by_tenant.setdefault(tenant, []).extend(
+                obs.QUEUE_WAIT.samples(task=task, tenant=tenant))
+        for tenant, samples in by_tenant.items():
+            p50 = obs.percentile(samples, 50.0)
+            if p50 is not None:
+                vals[f"queue_wait_p50_ms_tenant_{tenant}"] = float(p50)
         # Burn-rate states ride the same cadence, so PAGE transitions trip
         # the recorder even when nobody is scraping /debug/slo.
         worst = self.slos.worst_state()
@@ -430,8 +472,25 @@ class ServeApp:
             lambda eng: eng.load_params(params))
         report["total_s"] = round(time.perf_counter() - t0, 3)
         report["checkpoint"] = checkpoint_path or "<in-memory>"
+        # The swap changed what the model computes: bump the generation so
+        # the cache-key fingerprint rotates, and drop every entry minted
+        # under the old generation in one transaction. A post-swap replay
+        # of a pre-swap request is therefore a MISS (fresh forward pass),
+        # never a stale hit. In-flight leaders keep their follower rows —
+        # their old-generation result still fans out, it just isn't cached.
+        self.model_gen += 1
+        if self.cache is not None:
+            dropped = self.cache.invalidate(self._cache_fingerprint())
+            obs.RESULT_CACHE_INVALIDATIONS.inc(dropped)
+            report["cache_invalidated"] = dropped
         self.boot_info["last_swap"] = report
         return report
+
+    def _cache_fingerprint(self) -> str:
+        """Cache-key config component: the static config fingerprint plus
+        the rolling-swap generation. Both a config change (across restarts)
+        and a live swap (within one process) rotate every key."""
+        return f"{self.fingerprint}:g{self.model_gen}"
 
     def _run_worker(self) -> None:
         """Thread entry for the in-process worker. The crash guard lives

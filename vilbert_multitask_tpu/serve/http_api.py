@@ -37,6 +37,7 @@ from vilbert_multitask_tpu.resilience import AdmissionController, Deadline
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, make_job_message
+from vilbert_multitask_tpu.serve.resultcache import ResultCache, cache_key
 
 
 class ApiServer:
@@ -56,6 +57,7 @@ class ApiServer:
         fleet=None,
         attrib=None,
         tracestore=None,
+        cache: Optional[ResultCache] = None,
     ):
         self.queue = queue
         self.store = store
@@ -92,6 +94,11 @@ class ApiServer:
         # aged out of every live ring.
         self.attrib = attrib
         self.tracestore = tracestore
+        # Durable result cache + singleflight registry (ServeApp wires
+        # it; serve/resultcache.py). POST / consults it before any queue
+        # publish: hits answer straight from sqlite (no queue, no TPU),
+        # identical in-flight submits coalesce onto one leader job.
+        self.cache = cache
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -150,25 +157,93 @@ class ApiServer:
         log_to_terminal(self.hub, socket_id,
                         {"info": f"Starting {spec.name} job..."})
         collect = payload.get("collect_attention", False)
-        job_id = self.queue.publish(
-            make_job_message(
-                images, question, task_id, socket_id,
-                # "full" passes through (complete per-head maps persisted);
-                # any other truthy value → compact summary.
-                collect_attention=("full" if collect == "full"
-                                   else bool(collect)),
-                trace_id=trace_id,
-                # Optional caller-declared tenant for cost attribution
-                # (vmt_device_seconds_total{task,tenant}); absent → "anon".
-                tenant=str(payload.get("tenant", "") or "") or None,
-                # The deadline is minted HERE — queueing time counts against
-                # the budget, so a job stuck behind a backlog expires instead
-                # of burning a forward for a long-gone client.
-                deadline=(Deadline(budget).to_wire()
-                          if budget and budget > 0 else None),
-                published_unix=time.time()))
+        # Optional caller-declared tenant for cost attribution
+        # (vmt_device_seconds_total{task,tenant}); absent → "anon".
+        tenant = str(payload.get("tenant", "") or "") or None
+        # --- duplicate-traffic tier (serve/resultcache.py) ---
+        # One atomic claim decides the submit's fate: a durable HIT is
+        # answered right here (no queue, no TPU), an identical in-flight
+        # submit ATTACHES as a follower of the one leader job (the
+        # leader's terminal fans out to it), and everything else LEADS —
+        # publishes the one real job with the key stamped on the body.
+        # Attention-collecting jobs bypass the tier: their payload
+        # (persisted per-request .npz maps) is per-submit state.
+        key = None
+        if self.cache is not None and not collect:
+            key = cache_key(task_id, images, question,
+                            self.cache.fingerprint)
+            verdict_c, value = self.cache.admit(
+                key, socket_id=socket_id, trace_id=trace_id,
+                tenant=tenant, coalesce=self.serving.coalesce_enabled)
+            if verdict_c == "hit":
+                return self._serve_cache_hit(spec, socket_id, trace_id,
+                                             tenant, value, sp)
+            if verdict_c == "attach":
+                obs.COALESCED_SUBMITS.inc()
+                # The follower's cost record opens here; the leader's
+                # terminal fan-out closes it with only a push charge —
+                # its forward is the leader's, shared.
+                obs.job_begin(trace_id, job_id=value,
+                              task=str(task_id), tenant=tenant or "anon")
+                sp.set(task_id=task_id, coalesced=True)
+                return 200, {"job_id": value, "task": spec.name,
+                             "cache": "coalesced"}
+            obs.RESULT_CACHE_MISSES.inc()
+        try:
+            job_id = self.queue.publish(
+                make_job_message(
+                    images, question, task_id, socket_id,
+                    # "full" passes through (complete per-head maps
+                    # persisted); any other truthy value → compact summary.
+                    collect_attention=("full" if collect == "full"
+                                       else bool(collect)),
+                    trace_id=trace_id,
+                    tenant=tenant,
+                    # The deadline is minted HERE — queueing time counts
+                    # against the budget, so a job stuck behind a backlog
+                    # expires instead of burning a forward for a long-gone
+                    # client.
+                    deadline=(Deadline(budget).to_wire()
+                              if budget and budget > 0 else None),
+                    published_unix=time.time(),
+                    cache_key=key))
+        except Exception:
+            # Leadership was claimed above: a failed publish must drop
+            # the claim, or every future identical submit would attach
+            # to a leader job that never existed.
+            if self.cache is not None and key:
+                self.cache.abandon(key)
+            raise
+        if self.cache is not None and key:
+            self.cache.set_leader(key, job_id)
         sp.set(task_id=task_id, job_id=job_id, n_images=len(images))
-        return 200, {"job_id": job_id, "task": spec.name}
+        body = {"job_id": job_id, "task": spec.name}
+        if key:
+            body["cache"] = "miss"
+        return 200, body
+
+    def _serve_cache_hit(self, spec, socket_id: str, trace_id: str,
+                         tenant: Optional[str], payload: Dict[str, Any],
+                         sp) -> Tuple[int, Dict[str, Any]]:
+        """Answer one submit straight from the durable result cache: the
+        same result + completion frames the worker would push, plus the
+        payload inline in the 200 body with the ``cache: hit`` marker.
+        The cost record charges ONLY the push — zero forward/device
+        share, so device-second conservation is untouched (device time
+        accrues via job_batch alone)."""
+        obs.RESULT_CACHE_HITS.inc()
+        obs.job_begin(trace_id, task=str(spec.task_id),
+                      tenant=tenant or "anon")
+        t_push = time.perf_counter()
+        log_to_terminal(self.hub, socket_id,
+                        {"result": payload, "cache": "hit"})
+        log_to_terminal(self.hub, socket_id,
+                        {"terminal": "Task completed from result cache.",
+                         "cache": "hit"})
+        obs.job_charge(trace_id, "push", time.perf_counter() - t_push)
+        obs.job_finish(trace_id, "ok")
+        sp.set(task_id=spec.task_id, cache="hit")
+        return 200, {"task": spec.name, "cache": "hit", "result": payload}
 
     def _admission_decision(self):
         counts = self.queue.counts()

@@ -81,6 +81,20 @@ def log_to_terminal(hub: PushHub, socket_id: str, message: Dict[str, Any]) -> No
     hub.publish(socket_id, message)
 
 
+def fan_out(hub: PushHub, socket_ids: List[str],
+            message: Dict[str, Any]) -> int:
+    """Publish one frame to MANY groups — the coalescing tier's terminal
+    fan-out (worker._fan_to_followers): every follower of a singleflight
+    leader hears the leader's result/dead-letter/deadline frame. Each
+    group gets its own dict copy (subscriber queues outlive this call;
+    a shared mutable frame would alias across consumers). Returns total
+    subscriber deliveries, same best-effort contract as publish."""
+    delivered = 0
+    for sid in socket_ids:
+        delivered += hub.publish(sid, dict(message))
+    return delivered
+
+
 class WebSocketBridge:
     """Asyncio websocket server bridging :class:`PushHub` to browsers.
 

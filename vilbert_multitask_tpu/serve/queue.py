@@ -320,7 +320,8 @@ def make_job_message(image_paths, question: str, task_id: int,
                      trace_id: "str | None" = None,
                      deadline: "Dict[str, float] | None" = None,
                      published_unix: "float | None" = None,
-                     tenant: "str | None" = None
+                     tenant: "str | None" = None,
+                     cache_key: "str | None" = None
                      ) -> Dict[str, Any]:
     """The reference wire schema (demo/sender.py:26-31): ``image_path`` is a
     list of absolute paths, ``question`` the (pre-lowercased) query.
@@ -360,4 +361,11 @@ def make_job_message(image_paths, question: str, task_id: int,
         # charge this job's device-seconds to. Absent means "anon" —
         # the attributor defaults it, so old producers stay valid.
         msg["tenant"] = tenant
+    if cache_key:
+        # Result-cache/singleflight key (serve/resultcache.py): this job
+        # is the leader for the key — the worker writes the result
+        # through at completion and fans every terminal frame out to the
+        # key's coalesced followers. Absent means uncacheable (e.g.
+        # attention-collecting submits) — terminals stay point-to-point.
+        msg["cache_key"] = cache_key
     return msg

@@ -59,13 +59,19 @@ class ReadyItem:
     ``solo`` marks attention-map requests: they need a per-request forward
     flag, so they skip shared intake here (``step_one`` runs the whole
     pipeline for them) and never pack into a shared chunk.
+
+    ``tenant`` is the job body's billing dimension, reused as the QoS
+    class the deficit tier budgets by; ``deferred`` flips when a fire
+    passed this item over for tenant-budget reasons (not row pressure
+    alone), so an expiry while deferred sheds as ``tenant_budget``
+    instead of ``deadline``.
     """
 
     __slots__ = ("job", "qa_id", "prepared", "t0", "deadline", "enq_t",
-                 "solo")
+                 "solo", "tenant", "deferred")
 
     def __init__(self, job: Job, qa_id, prepared, t0, deadline, enq_t,
-                 solo: bool = False):
+                 solo: bool = False, tenant: str = "anon"):
         self.job = job
         self.qa_id = qa_id
         self.prepared = prepared
@@ -73,6 +79,8 @@ class ReadyItem:
         self.deadline = deadline
         self.enq_t = enq_t
         self.solo = solo
+        self.tenant = tenant
+        self.deferred = False
 
     def rows(self) -> int:
         return self.prepared.n_images if self.prepared is not None else 1
@@ -105,29 +113,88 @@ def fire_decision(now: float, *, rows: int, oldest_enq_t: float,
     return False, max(min(window_wait, deadline_wait), 0.0)
 
 
-def select_batch(ready: List[ReadyItem], now: float, max_rows: int
+def select_batch(ready: List[ReadyItem], now: float, max_rows: int, *,
+                 deficits: "Optional[dict]" = None,
+                 weights: "Optional[dict]" = None,
+                 default_weight: float = 1.0
                  ) -> Tuple[List[ReadyItem], List[ReadyItem],
                             List[ReadyItem]]:
-    """Pure EDF packing: ``(batch, expired, rest)``.
+    """Pure packing: ``(batch, expired, rest)``.
 
     Members sort earliest-deadline-first; already-expired members are
     split out for shedding (the caller expires them OUTSIDE the scheduler
     lock — expiry pushes/acks block). Packing stops charging the row
     budget once ``max_rows`` is reached; later members stay ready, still
     in EDF order, for the next fire.
+
+    With ``deficits`` (the caller's persistent tenant→credit map) a
+    weighted-deficit tier sits ABOVE the deadline ordering: each fire
+    grants every present tenant ``max_rows * w/Σw`` rows of credit
+    (weights from ServingConfig.tenant_weights, ``default_weight`` for
+    unlisted tenants), then repeatedly packs the EDF head of the
+    highest-credit tenant, spending its credit per row. The tier is
+    work-conserving — the device never idles for fairness; under
+    contention a hot tenant's surplus items are the ones passed over
+    (marked ``deferred``, shed as ``tenant_budget`` if they expire
+    waiting). A tenant whose backlog fully drains in a fire resets to
+    zero credit and leaves the map, bounding its cardinality to tenants
+    with live backlog. ``deficits=None`` is the pure-EDF legacy path.
     """
     batch: List[ReadyItem] = []
     expired: List[ReadyItem] = []
     rest: List[ReadyItem] = []
-    rows = 0
+    live: List[ReadyItem] = []
     for item in sorted(ready, key=ReadyItem.expiry):
         if item.deadline is not None and item.expiry() <= now:
             expired.append(item)
-        elif rows < max_rows:
-            batch.append(item)
-            rows += item.rows()
         else:
-            rest.append(item)
+            live.append(item)
+    if deficits is None:
+        rows = 0
+        for item in live:
+            if rows < max_rows:
+                batch.append(item)
+                rows += item.rows()
+            else:
+                rest.append(item)
+        return batch, expired, rest
+    # --- tenant-weighted deficit tier (DRR) above EDF ---
+    weights = weights or {}
+    present: "dict[str, List[ReadyItem]]" = {}
+    for item in live:
+        present.setdefault(item.tenant, []).append(item)
+    if present:
+        total_w = sum(max(weights.get(t, default_weight), 1e-9)
+                      for t in present)
+        for t in present:
+            share = max(weights.get(t, default_weight), 1e-9) / total_w
+            # Credit carries over between fires (a starved tenant's
+            # backlog catches up) but is capped so an idle-then-bursty
+            # tenant cannot hoard the whole device.
+            deficits[t] = min(deficits.get(t, 0.0) + max_rows * share,
+                              2.0 * max_rows)
+    rows = 0
+    while rows < max_rows:
+        cands = [t for t, items in present.items() if items]
+        if not cands:
+            break
+        # Highest credit wins the slot; earliest deadline breaks ties.
+        t = max(cands, key=lambda c: (deficits.get(c, 0.0),
+                                      -present[c][0].expiry()))
+        item = present[t].pop(0)
+        batch.append(item)
+        rows += item.rows()
+        deficits[t] = deficits.get(t, 0.0) - item.rows()
+    for t, items in list(present.items()):
+        if items:
+            for item in items:
+                item.deferred = True
+                rest.append(item)
+        else:
+            # Backlog fully served: classic DRR resets the credit, and
+            # dropping the entry bounds the map to live-backlog tenants.
+            deficits.pop(t, None)
+    rest.sort(key=ReadyItem.expiry)
     return batch, expired, rest
 
 
@@ -169,6 +236,18 @@ class ContinuousScheduler:
         self._window_s = self.serving.sched_window_min_s
         self._stats = {"batches": 0, "jobs": 0, "shed": 0, "released": 0,
                        "solo": 0}
+        # Tenant-weighted fairness state (select_batch's deficit tier):
+        # the persistent tenant→credit map, the configured weights, and
+        # a per-tenant queue-wait EWMA for the sampler. All guarded by
+        # _cond like the rest of the scheduler state.
+        self._fairness = bool(
+            getattr(self.serving, "tenant_fairness_enabled", False))
+        self._weights = dict(
+            getattr(self.serving, "tenant_weights", None) or {})
+        self._default_weight = float(
+            getattr(self.serving, "tenant_default_weight", 1.0))
+        self._deficits: dict = {}
+        self._tenant_wait_ms: dict = {}
         self._completions: stdlib_queue.Queue = stdlib_queue.Queue(
             maxsize=self.serving.sched_completion_depth)
         # Replica-pool mode: when the worker's engine is a ReplicaPool
@@ -221,11 +300,12 @@ class ContinuousScheduler:
                 continue  # expired on arrival: terminal push already sent
             enq_t = self.clock()
             deadline = self.worker._deadline_of(job)
+            tenant = str(job.body.get("tenant") or "anon")
             if job.body.get("collect_attention"):
                 # Per-request forward flag: step_one runs the whole
                 # pipeline solo at dispatch, so no shared intake here.
                 item = ReadyItem(job, None, None, None, deadline, enq_t,
-                                 solo=True)
+                                 solo=True, tenant=tenant)
             else:
                 try:
                     with obs.trace_scope(job.body.get("trace_id")), \
@@ -235,7 +315,8 @@ class ContinuousScheduler:
                 except Exception:
                     self.worker._fail_job(job)
                     continue
-                item = ReadyItem(job, qa_id, prepared, t0, deadline, enq_t)
+                item = ReadyItem(job, qa_id, prepared, t0, deadline, enq_t,
+                                 tenant=tenant)
             with self._cond:
                 self._ready.append(item)
                 self._cond.notify()
@@ -267,11 +348,19 @@ class ContinuousScheduler:
                 if not fire:
                     self._cond.wait(min(wait_s, self.poll_interval_s))
                     continue
-                batch, expired, rest = select_batch(self._ready, now,
-                                                    max_rows)
+                batch, expired, rest = select_batch(
+                    self._ready, now, max_rows,
+                    deficits=self._deficits if self._fairness else None,
+                    weights=self._weights,
+                    default_weight=self._default_weight)
                 # Slice-assign keeps the one list object (and is the
                 # truncation idiom VMT115 audits in this plane).
                 self._ready[:] = rest
+                if self._fairness:
+                    # In-memory gauge set — non-blocking, fine under
+                    # _cond (VMT116 audits blocking calls only).
+                    for t, credit in self._deficits.items():
+                        obs.TENANT_DEFICIT.set(credit, tenant=t)
                 if batch:
                     fill = min(
                         sum(i.rows() for i in batch) / max_rows, 1.0)
@@ -309,6 +398,16 @@ class ContinuousScheduler:
             obs.SCHED_WAIT.observe(max(now - item.enq_t, 0.0) * 1e3)
             obs.job_charge(item.job.body.get("trace_id", ""),
                            "ready_wait", max(now - item.enq_t, 0.0))
+        with self._cond:
+            # Per-tenant queue-wait EWMA for the sampler: the fairness
+            # tier's observable effect is exactly this number staying
+            # flat for light tenants while a hot tenant backlogs.
+            for item in batch:
+                wait_ms = max(now - item.enq_t, 0.0) * 1e3
+                prev = self._tenant_wait_ms.get(item.tenant)
+                self._tenant_wait_ms[item.tenant] = (
+                    wait_ms if prev is None
+                    else 0.8 * prev + 0.2 * wait_ms)
         packed = [i for i in batch if not i.solo]
         solos = [i for i in batch if i.solo]
         for item in solos:
@@ -460,7 +559,13 @@ class ContinuousScheduler:
                 for item in expired:
                     with self._cond:
                         self._stats["shed"] += 1
-                    self.worker._expire_job(item.job)
+                    # An expiry while tenant-budget-deferred is the
+                    # fairness tier's shed, not plain overload — keep
+                    # the classes separate in vmt_shed_total{reason}.
+                    self.worker._expire_job(
+                        item.job,
+                        reason=("tenant_budget" if item.deferred
+                                else "deadline"))
                 if batch:
                     self._dispatch(batch)
         finally:
@@ -488,13 +593,21 @@ class ContinuousScheduler:
                 obs.record_event("job_abandoned", job_id=item.job.id,
                                  trace_id=item.job.body.get("trace_id"),
                                  replica=abandoned_by)
+                frame = {
+                    "terminal": "Server draining; job requeued for the "
+                                "next worker.",
+                    "requeued": True,
+                    "abandoned_by": abandoned_by,
+                    "question": item.job.body.get("question", ""),
+                }
                 log_to_terminal(
                     self.worker.hub, item.job.body.get("socket_id", ""),
-                    {"terminal": "Server draining; job requeued for the "
-                                 "next worker.",
-                     "requeued": True,
-                     "abandoned_by": abandoned_by,
-                     "question": item.job.body.get("question", "")})
+                    frame)
+                # Requeue, not a terminal: coalesced followers stay
+                # attached and hear the notice; the next worker's
+                # terminal fan-out settles them.
+                self.worker._fan_to_followers(item.job.body, [frame],
+                                              final=False)
                 self.worker._untrack(item.job.id)
             self._completions.put(None)
             completion.join()
@@ -504,7 +617,7 @@ class ContinuousScheduler:
         """Scheduler state for the time-series sampler. ``*_total`` keys
         get ``_per_s`` rates derived by the sampler."""
         with self._cond:
-            return {
+            vals = {
                 "sched_ready": float(len(self._ready)),
                 "sched_window_ms": self._window_s * 1e3,
                 "sched_batches_total": float(self._stats["batches"]),
@@ -515,3 +628,11 @@ class ContinuousScheduler:
                 "sched_completion_backlog":
                     float(self._completions.qsize()),
             }
+            # Per-tenant queue-wait (EWMA over dispatched items) and live
+            # deficit credit — cardinality bounded by tenants actually
+            # seen / holding backlog.
+            for t, v in self._tenant_wait_ms.items():
+                vals[f"sched_tenant_wait_ms.{t}"] = float(v)
+            for t, v in self._deficits.items():
+                vals[f"sched_tenant_deficit.{t}"] = float(v)
+            return vals

@@ -28,9 +28,10 @@ from vilbert_multitask_tpu.resilience.faults import fault_point
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.metrics import Metrics
 from vilbert_multitask_tpu.serve.pool import ReplicaFailover
-from vilbert_multitask_tpu.serve.push import PushHub, log_to_terminal
+from vilbert_multitask_tpu.serve.push import PushHub, fan_out, log_to_terminal
 from vilbert_multitask_tpu.serve.queue import DurableQueue, Job
 from vilbert_multitask_tpu.serve.render import draw_grounding_boxes
+from vilbert_multitask_tpu.serve.resultcache import ResultCache
 
 
 def _attention_summary(out) -> Dict[str, Any]:
@@ -101,6 +102,7 @@ class ServeWorker:
         hub: PushHub,
         serving: Optional[ServingConfig] = None,
         metrics: Optional[Metrics] = None,
+        cache: Optional[ResultCache] = None,
     ):
         self.engine = engine
         self.queue = queue
@@ -108,6 +110,11 @@ class ServeWorker:
         self.hub = hub
         self.serving = serving or ServingConfig()
         self.metrics = metrics or Metrics()
+        # Durable result cache + singleflight follower registry
+        # (serve/resultcache.py). When a finished job carries a
+        # ``cache_key``, its result is written through here and every
+        # terminal frame fans out to the key's coalesced followers.
+        self.cache = cache
         # Claimed-but-unfinished jobs, for graceful drain: stop() releases
         # these back to the queue (no attempt charged) and tells the client.
         self._inflight_lock = threading.Lock()
@@ -208,7 +215,8 @@ class ServeWorker:
                 wait_s = time.time() - float(published)  # vmtlint: disable=VMT109
                 obs.QUEUE_WAIT.observe(
                     max(wait_s, 0.0) * 1e3,
-                    task=str(job.body.get("task_id", "")))
+                    task=str(job.body.get("task_id", "")),
+                    tenant=str(job.body.get("tenant") or "anon"))
                 obs.job_charge(trace_id, "queue_wait", max(wait_s, 0.0))
             with self._inflight_lock:
                 self._inflight[job.id] = job
@@ -234,16 +242,22 @@ class ServeWorker:
             # Close any cost record a dead prior holder left open, so the
             # quarantine verdict (not an eviction) is what the store keeps.
             obs.job_finish(job.body.get("trace_id", ""), "dead_letter")
-            log_to_terminal(
-                self.hub, job.body.get("socket_id", ""),
-                {"terminal": "Job quarantined: it was delivered "
-                             f"{job.deliveries} times without completing "
-                             "and will not be retried.",
-                 "error": "poison job dead-lettered after "
-                          f"{job.deliveries} deliveries",
-                 "dead_letter": True,
-                 "process": obs.process_identity().ident,
-                 "question": job.body.get("question", "")})
+            frame = {
+                "terminal": "Job quarantined: it was delivered "
+                            f"{job.deliveries} times without completing "
+                            "and will not be retried.",
+                "error": "poison job dead-lettered after "
+                         f"{job.deliveries} deliveries",
+                "dead_letter": True,
+                "process": obs.process_identity().ident,
+                "question": job.body.get("question", ""),
+            }
+            log_to_terminal(self.hub, job.body.get("socket_id", ""), frame)
+            # Quarantine is a terminal: followers coalesced onto this
+            # job must hear it too, and the singleflight claim drops so
+            # a retry submit republishes instead of attaching.
+            self._fan_to_followers(job.body, [frame],
+                                   verdict="dead_letter", drop_claim=True)
 
     def _failover_job(self, job: Job, replica: str) -> str:
         """Move a job off a failed replica: release (no attempt charged),
@@ -261,15 +275,62 @@ class ServeWorker:
         self.queue.release(job.id)
         self._untrack(job.id)
         obs.job_finish(job.body.get("trace_id", ""), "failover")
-        log_to_terminal(
-            self.hub, job.body.get("socket_id", ""),
-            {"terminal": f"Replica {replica} failed mid-inference; job "
-                         "requeued on a healthy replica.",
-             "requeued": True,
-             "replica": replica,
-             "process": obs.process_identity().ident,
-             "question": job.body.get("question", "")})
+        frame = {
+            "terminal": f"Replica {replica} failed mid-inference; job "
+                        "requeued on a healthy replica.",
+            "requeued": True,
+            "replica": replica,
+            "process": obs.process_identity().ident,
+            "question": job.body.get("question", ""),
+        }
+        log_to_terminal(self.hub, job.body.get("socket_id", ""), frame)
+        # Not a terminal: the job reruns on a healthy replica, so
+        # followers stay attached (peek) and just hear the requeue.
+        self._fan_to_followers(job.body, [frame], final=False)
         return "requeued"
+
+    # --------------------------------------------------- coalesced fan-out
+    def _fan_to_followers(self, body: Dict[str, Any],
+                          frames: List[Dict[str, Any]], *,
+                          verdict: Optional[str] = None,
+                          final: bool = True,
+                          drop_claim: bool = False) -> None:
+        """Fan the leader's frames out to every coalesced follower.
+
+        ``final=True`` destructively pops the follower registry inside
+        one write transaction, so each follower receives its terminal
+        frames exactly once — exactly-one-terminal per *submit*, not
+        just per job, no matter how many workers race the leader's
+        terminal. ``final=False`` peeks (requeued/failover notices):
+        followers stay attached for the eventual terminal.
+        ``drop_claim`` additionally abandons the singleflight claim so
+        the next identical submit retries instead of attaching to a key
+        whose leader already failed. ``verdict`` closes each follower's
+        cost record — a follower is charged ONLY the push (its forward
+        was the leader's; device-second conservation is untouched
+        because device time accrues via job_batch alone).
+        """
+        if self.cache is None:
+            return
+        key = body.get("cache_key")
+        if not key:
+            return
+        followers = (self.cache.pop_followers(key) if final
+                     else self.cache.peek_followers(key))
+        if followers:
+            t_push = time.perf_counter()
+            sids = [f.socket_id for f in followers]
+            for frame in frames:
+                fan_out(self.hub, sids, dict(frame, coalesced=True))
+            if verdict is not None:
+                # The fan wall splits evenly: push is the ONLY stage a
+                # follower is charged for.
+                share = (time.perf_counter() - t_push) / len(followers)
+                for f in followers:
+                    obs.job_charge(f.trace_id or "", "push", share)
+                    obs.job_finish(f.trace_id or "", verdict)
+        if drop_claim:
+            self.cache.abandon(key)
 
     def _untrack(self, job_id: int) -> None:
         with self._inflight_lock:
@@ -298,22 +359,31 @@ class ServeWorker:
         self._expire_job(job)
         return True
 
-    def _expire_job(self, job: Job) -> None:
+    def _expire_job(self, job: Job, *, reason: str = "deadline") -> None:
         """Terminate an expired job: terminal push + ack (the client gave
         up waiting; a forward would be pure waste). Ack, not nack — the
-        outcome is final, not retryable."""
-        obs.SHED_COUNTER.inc(reason="deadline")
+        outcome is final, not retryable. ``reason`` classifies the shed
+        (``deadline`` for plain EDF expiry, ``tenant_budget`` when the
+        deficit scheduler's fairness tier deferred the job past its
+        deadline) so vmt_shed_total separates overload from QoS policy."""
+        obs.SHED_COUNTER.inc(reason=reason)
         # One expiry is traffic; a burst is an incident. The spike tracker
         # dumps a postmortem bundle only when expiries cluster.
         obs.record_spike("deadline_spike",
                          trace_id=job.body.get("trace_id"),
                          task_id=job.body.get("task_id", ""))
-        log_to_terminal(
-            self.hub, job.body.get("socket_id", ""),
-            {"terminal": "Deadline exceeded before the job could be "
-                         "served; not retried.",
-             "deadline_exceeded": True,
-             "question": job.body.get("question", "")})
+        frame = {
+            "terminal": "Deadline exceeded before the job could be "
+                        "served; not retried.",
+            "deadline_exceeded": True,
+            "question": job.body.get("question", ""),
+        }
+        log_to_terminal(self.hub, job.body.get("socket_id", ""), frame)
+        # Expiry is a terminal: every coalesced follower hears it
+        # (exactly one terminal per submit) and the singleflight claim
+        # drops so a fresh submit retries with a fresh deadline.
+        self._fan_to_followers(job.body, [frame],
+                               verdict="deadline", drop_claim=True)
         self.queue.ack(job.id)
         self._untrack(job.id)
         obs.job_finish(job.body.get("trace_id", ""), "deadline")
@@ -493,12 +563,25 @@ class ServeWorker:
         self.metrics.record(req.spec.task_id, elapsed_ms,
                             exemplar_trace_id=trace_id)
         obs.job_charge(trace_id, "decode", time.perf_counter() - t_dec)
+        # Write-through BEFORE any push: once the first client can see
+        # the answer, an identical submit must already be a cache hit.
+        key = body.get("cache_key")
+        if self.cache is not None and key:
+            self.cache.complete(key, payload)
         t_push = time.perf_counter()
         with obs.span("worker.push", task_id=req.spec.task_id):
             log_to_terminal(self.hub, socket_id, {"result": payload})
             log_to_terminal(
                 self.hub, socket_id,
                 {"terminal": f"Task completed in {elapsed_ms:.0f} ms"})
+            # Singleflight payoff: every coalesced follower gets the one
+            # shared result — each charged only its own push.
+            self._fan_to_followers(
+                body,
+                [{"result": payload},
+                 {"terminal": f"Task completed in {elapsed_ms:.0f} ms "
+                              "(coalesced)"}],
+                verdict="ok")
         obs.job_charge(trace_id, "push", time.perf_counter() - t_push)
         obs.job_finish(trace_id, "ok")
         return payload
@@ -520,11 +603,17 @@ class ServeWorker:
         obs.job_finish(job.body.get("trace_id", ""),
                        "dead_letter" if status == "dead" else "requeued")
         if status == "dead":
-            log_to_terminal(
-                self.hub, job.body.get("socket_id", ""),
-                {"terminal": "Job failed permanently.",
-                 "error": traceback.format_exc(limit=3),
-                 "question": job.body.get("question", "")})
+            frame = {
+                "terminal": "Job failed permanently.",
+                "error": traceback.format_exc(limit=3),
+                "question": job.body.get("question", ""),
+            }
+            log_to_terminal(self.hub, job.body.get("socket_id", ""), frame)
+            # Dead-letter is a terminal: fan it to every coalesced
+            # follower and drop the singleflight claim so the next
+            # identical submit retries instead of attaching.
+            self._fan_to_followers(job.body, [frame],
+                                   verdict="dead_letter", drop_claim=True)
         return "requeued" if status == "pending" else status
 
     def step_one(self, job: Job) -> str:
@@ -571,14 +660,18 @@ class ServeWorker:
             obs.record_event("job_abandoned", job_id=job.id,
                              trace_id=job.body.get("trace_id"),
                              replica=replica)
-            log_to_terminal(
-                self.hub, job.body.get("socket_id", ""),
-                {"terminal": "Server draining; job requeued for the next "
-                             "worker.",
-                 "requeued": True,
-                 "abandoned_by": replica,
-                 "process": obs.process_identity().ident,
-                 "question": job.body.get("question", "")})
+            frame = {
+                "terminal": "Server draining; job requeued for the next "
+                            "worker.",
+                "requeued": True,
+                "abandoned_by": replica,
+                "process": obs.process_identity().ident,
+                "question": job.body.get("question", ""),
+            }
+            log_to_terminal(self.hub, job.body.get("socket_id", ""), frame)
+            # Requeue, not a terminal: followers stay attached and the
+            # claim survives — the next worker's terminal fans to them.
+            self._fan_to_followers(job.body, [frame], final=False)
         return len(abandoned)
 
     def scheduler_stats(self) -> Dict[str, float]:

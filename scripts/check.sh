@@ -167,6 +167,23 @@ echo "== zipf chaos smoke (coalesced leader dies, followers still close) =="
 JAX_PLATFORMS=cpu python scripts/serve_soak.py --zipf --chaos --jobs 48 \
   --seed 3 --out /tmp/ZIPF_CHAOS_SOAK.json || fail=1
 
+echo "== autoscale smoke (flash crowd: breach -> grow -> trough -> retire) =="
+# Closed-loop autoscaler under a diurnal + flash-crowd shape: the spike
+# must add capacity within one AOT-boot latency of the sustained-breach
+# decision, nothing with deadline slack sheds during scale-out, and the
+# trough retires the pool back to the floor — exactly one terminal per job
+# throughout. Ledger keys: autoscale.time_to_scale_out_s / spike_p95_ms.
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --autoscale \
+  --out /tmp/AUTOSCALE_SOAK.json || fail=1
+
+echo "== autoscale chaos smoke (poison storm: loud signals, zero scale-out) =="
+# Seeded worker.intake storm dead-letters every job while slow claims pile
+# queue wait over the breach band: the controller must HOLD (poison_storm
+# decisions), never add a replica, and the dead-letter fan still closes
+# every socket exactly once.
+JAX_PLATFORMS=cpu python scripts/serve_soak.py --autoscale --chaos \
+  --seed 11 --out /tmp/AUTOSCALE_CHAOS_SOAK.json || fail=1
+
 echo "== quant smoke (int8 storage parity + roofline-knee plumbing) =="
 # Tiny f32 vs int8 engine: quantized tree reads <0.35x the bytes, one
 # task per decode family stays within quantization noise through the

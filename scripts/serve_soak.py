@@ -29,9 +29,20 @@ one replica is silently killed mid-burst and the run asserts exactly one
 terminal per job, zero double-executions, and the dead replica visible in
 /healthz within about one sampler cadence. Artifact: SERVE_SOAK_POOL.json.
 
+``--autoscale`` runs the CLOSED-LOOP AUTOSCALER soak: a diurnal +
+flash-crowd load shape (ramp → spike → trough) over dryrun replicas with
+``serve/autoscale.py`` live on the sampler tick. The spike must grow the
+pool within one AOT-boot latency of the sustained-breach decision with
+nothing shed, and the trough must retire capacity back to the floor;
+``--autoscale --chaos`` instead floods poisoned jobs (seeded
+``worker.intake`` faults + slow claims) and asserts the controller never
+scales into the poison storm. Artifact: SERVE_SOAK_AUTOSCALE.json;
+ledger metric: ``autoscale.soak``.
+
 Usage: python scripts/serve_soak.py [--jobs 96] [--out SERVE_SOAK.json]
        [--full] [--chaos] [--seed 0]
        [--replicas 2 --dryrun [--kill-replica]]
+       [--autoscale [--chaos]] [--zipf [--chaos]]
 """
 
 from __future__ import annotations
@@ -113,7 +124,8 @@ def _ledger_attrib(report: dict, verdict: bool) -> None:
         print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
 
 
-def _build_cfg(root: str, full: bool, tenant_weights=None):
+def _build_cfg(root: str, full: bool, tenant_weights=None,
+               extra_serving=None):
     from vilbert_multitask_tpu.config import (
         EngineConfig,
         FrameworkConfig,
@@ -128,22 +140,26 @@ def _build_cfg(root: str, full: bool, tenant_weights=None):
         image_buckets=(1, 2, 4), throughput_buckets=(8, 16),
         use_pallas_coattention=False, use_pallas_self_attention=False,
     )
-    cfg = FrameworkConfig(
-        model=model, engine=engine,
-        serving=ServingConfig(
-            queue_db_path=os.path.join(root, "queue.sqlite3"),
-            results_db_path=os.path.join(root, "results.sqlite3"),
-            media_root=os.path.join(root, "media"),
-            http_port=0, ws_port=0,
-            # Live-health plane tuned for a short run: fast sampler ticks,
-            # and every trigger event dumps a bundle (the chaos acceptance
-            # bar reads the injected fault's bundle back).
-            sampler_cadence_s=0.25,
-            recorder_min_interval_s=0.0,
-            recorder_max_bundles=64,
-            tenant_weights=tenant_weights,
-        ),
+    serving_kwargs = dict(
+        queue_db_path=os.path.join(root, "queue.sqlite3"),
+        results_db_path=os.path.join(root, "results.sqlite3"),
+        media_root=os.path.join(root, "media"),
+        http_port=0, ws_port=0,
+        # Live-health plane tuned for a short run: fast sampler ticks,
+        # and every trigger event dumps a bundle (the chaos acceptance
+        # bar reads the injected fault's bundle back).
+        sampler_cadence_s=0.25,
+        recorder_min_interval_s=0.0,
+        recorder_max_bundles=64,
+        tenant_weights=tenant_weights,
     )
+    # Mode-specific knob overrides (the autoscale soak shrinks windows and
+    # cooldowns to CI scale) land BEFORE fingerprinting: the ledger must
+    # key baselines on the config that actually ran.
+    if extra_serving:
+        serving_kwargs.update(extra_serving)
+    cfg = FrameworkConfig(model=model, engine=engine,
+                          serving=ServingConfig(**serving_kwargs))
     global _FP
     _FP = config_fingerprint(cfg)
     return cfg
@@ -837,6 +853,374 @@ def run_zipf_soak(args) -> int:
     return 0 if verdict else 1
 
 
+# ----------------------------------------------------- autoscale soak
+def _ledger_autoscale(report: dict, verdict: bool) -> None:
+    """Ledger the autoscaler verdict under ``autoscale.soak``: the
+    breach→capacity latency and the spike-phase tail trend independently
+    of qps, and check() baselines are per-metric medians. The chaos
+    variant carries no timing keys (its bar is "never scaled"), so only
+    the plain run appends."""
+    try:
+        from vilbert_multitask_tpu import obs
+
+        a = report.get("autoscale") or {}
+        values = {k: v for k, v in a.items()
+                  if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        if values:
+            obs.ledger_append("autoscale.soak", values,
+                              config_fingerprint=_FP, extra={
+                                  "verdict": "pass" if verdict else "fail",
+                                  "chaos": "chaos" in report,
+                              })
+    except Exception as e:  # noqa: BLE001 — ride-along must never fail the soak
+        print(f"# perf-ledger append skipped: {e}", file=sys.stderr)
+
+
+# One warm AOT-cache replica boot costs ~2.6 s on the serving config
+# (PERF_LEDGER ``aot.boot``): the ISSUE's promptness bar — capacity must
+# exist within one boot latency of the sustained-breach decision.
+_AOT_BOOT_BAR_S = 2.6
+
+
+def run_autoscale_soak(args) -> int:
+    """The closed-loop autoscaler soak (``--autoscale``): a diurnal +
+    flash-crowd load shape against dryrun replicas.
+
+    Phases: **ramp** (gentle trickle — the pool must stay at one
+    replica), **spike** (a flash crowd floods the queue — queue-wait p95
+    breaches the target band, the controller must grow the pool within
+    one AOT-boot latency of the sustained-breach decision, and nothing
+    with deadline slack may shed), **trough** (traffic stops — sustained
+    slack must retire capacity back down to ``autoscale_min_replicas``).
+    Every submitted job must reach EXACTLY ONE terminal frame across all
+    three phases, and ``GET /debug/autoscale`` must replay the decision
+    history with inputs/thresholds/cooldown attached.
+
+    ``--chaos`` runs the poison-storm variant instead: a seeded
+    ``worker.intake`` fault plan dead-letters every job while slow claims
+    pile queue wait above the breach band — the classic trap where load
+    signals scream "scale out" but the work is poison. The controller
+    must hold (``poison_storm`` decisions), never add a replica, and the
+    dead-letter fan must still close every socket exactly once.
+
+    Artifact: SERVE_SOAK_AUTOSCALE.json; ledger: ``autoscale.soak``.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from vilbert_multitask_tpu.resilience import (
+        FaultPlan,
+        FaultRule,
+        clear_plan,
+        install_plan,
+    )
+    from vilbert_multitask_tpu.obs import percentile
+    from vilbert_multitask_tpu.serve.app import ServeApp
+    from vilbert_multitask_tpu.serve.autoscale import (
+        ACTION_SCALE_IN,
+        ACTION_SCALE_OUT,
+    )
+
+    service_ms = 40.0
+    overrides = dict(
+        autoscale_enabled=True,
+        autoscale_min_replicas=1,
+        autoscale_max_replicas=3,
+        # 150 ms target, band 75..180 ms: the ramp trickle sits far below,
+        # the spike backlog sits seconds above — both classifications are
+        # deterministic, not sampled.
+        autoscale_target_queue_wait_p95_ms=150.0,
+        autoscale_band_high=1.2,
+        autoscale_band_low=0.5,
+        autoscale_breach_ticks=2,
+        autoscale_slack_ticks=4,
+        autoscale_cooldown_out_s=1.0,
+        autoscale_cooldown_in_s=1.5,
+        autoscale_window_s=4.0,
+        autoscale_max_poison_rate_per_s=0.5,
+        # The whole run is ~150 ticks at the 0.25 s cadence; the ring must
+        # hold ALL of them so the scale-out record can't roll off before
+        # the trough-phase assertions read it back.
+        autoscale_decision_history=1024,
+        slo_fast_window_s=5.0,
+        slo_slow_window_s=15.0,
+    )
+    root = tempfile.mkdtemp(prefix="serve_soak_autoscale_")
+    cfg = _build_cfg(root, False, extra_serving=overrides)
+    eng = DryrunEngine(cfg, "r0", service_ms_per_row=service_ms)
+    app = ServeApp(cfg, engine=[eng],
+                   engine_factory=lambda: DryrunEngine(
+                       cfg, None, service_ms_per_row=service_ms))
+    app.start()
+    pool = app.engine
+    sock = "autoscale"
+    sub = app.hub.subscribe(sock)
+    terminals: dict = {}
+    dup_terminals: list = []
+    lock = threading.Lock()
+    stop_consume = threading.Event()
+
+    def consume():
+        while not stop_consume.is_set():
+            try:
+                frame = sub.get(timeout=0.2)
+            except queue_mod.Empty:
+                continue
+            if not _is_terminal_frame(frame):
+                continue
+            if "result" in frame:
+                q, kind = frame["result"]["question"], "result"
+            else:
+                q = frame.get("question", "")
+                kind = ("deadline" if frame.get("deadline_exceeded")
+                        else "error")
+            with lock:
+                if q in terminals:
+                    dup_terminals.append(q)
+                else:
+                    terminals[q] = (time.perf_counter(), kind)
+
+    reader = threading.Thread(target=consume, daemon=True,
+                              name="autoscale-consume")
+    reader.start()
+
+    conn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                      timeout=30)
+    submit_t: dict = {}
+
+    def post(phase: str, i: int) -> str:
+        task_id, q_t, n_img = PATTERN[i % len(PATTERN)]
+        q = q_t.format(i=f"{phase}-{i}")
+        body = json.dumps({
+            "task_id": task_id, "socket_id": sock, "question": q,
+            "image_list": [f"img_{k}.jpg" for k in range(n_img)],
+        })
+        conn.request("POST", "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        resp.read()
+        submit_t[q] = time.perf_counter()
+        return q
+
+    def live_count() -> int:
+        return sum(1 for r in pool.replicas_info()
+                   if r["state"] != "dead")
+
+    def decisions(action=None, reason=None):
+        ds = app.autoscaler.decisions_list()
+        if action is not None:
+            ds = [d for d in ds if d["action"] == action]
+        if reason is not None:
+            ds = [d for d in ds if d["reason"] == reason]
+        return ds
+
+    if args.chaos:
+        # ---- poison-storm variant: loud signals, poisoned work --------
+        n_jobs = 24
+        plan = install_plan(FaultPlan(args.seed, [
+            # Every intake attempt faults → every job burns its delivery
+            # attempts and dead-letters (bounded: attempts × jobs, plus
+            # margin — the site only fires with a claimed job in hand).
+            FaultRule("worker.intake", "error", rate=1.0,
+                      max_injections=3 * n_jobs + 16),
+            # Slow claims pile queue wait above the breach band while the
+            # storm runs: the load signal SCREAMS scale-out; only the
+            # poison gate stands between the controller and feeding a
+            # flapping pool.
+            FaultRule("queue.claim", "delay", rate=1.0, delay_s=0.05),
+        ]))
+        max_live = 1
+        try:
+            for i in range(n_jobs):
+                post("storm", i)
+            deadline_t = time.perf_counter() + 90.0
+            while time.perf_counter() < deadline_t:
+                max_live = max(max_live, live_count())
+                with lock:
+                    done = len(terminals)
+                if done >= n_jobs:
+                    break
+                time.sleep(0.05)
+            # A few more control ticks with the poison window still hot:
+            # the hold decisions the variant exists to witness.
+            settle_t = time.perf_counter() + 1.5
+            while time.perf_counter() < settle_t:
+                max_live = max(max_live, live_count())
+                time.sleep(0.05)
+        finally:
+            clear_plan()
+        with lock:
+            kinds = sorted({k for _, k in terminals.values()})
+            closed = len(terminals)
+        poison_holds = decisions(reason="poison_storm")
+        scale_outs = decisions(action=ACTION_SCALE_OUT)
+        injections = plan.injections()
+        stop_consume.set()
+        reader.join(timeout=5)
+        app.stop()
+        checks = {
+            "chaos_all_terminal": closed == n_jobs,
+            "chaos_exactly_one_terminal": not dup_terminals,
+            "chaos_all_dead_lettered": kinds == ["error"],
+            # THE bar: breach-shaped signals + poisoned work → hold.
+            "chaos_never_scaled_out": not scale_outs and max_live == 1,
+            "chaos_poison_gate_fired": len(poison_holds) >= 1,
+        }
+        report = {
+            "metric": "serve_soak_autoscale",
+            "jobs": n_jobs,
+            "completed": closed,
+            "terminal_kinds": kinds,
+            "max_live_replicas": max_live,
+            "poison_hold_decisions": len(poison_holds),
+            "max_poison_rate_per_s": round(max(
+                (d["inputs"]["poison_rate_per_s"]
+                 for d in app.autoscaler.decisions_list()), default=0.0), 2),
+            "chaos": {"seed": args.seed, "injections": injections},
+            "backend": "dryrun",
+            "checks": checks,
+        }
+        verdict = all(checks.values())
+        _ledger_autoscale(report, verdict)
+        out = args.out or "SERVE_SOAK_AUTOSCALE.json"
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps(report), flush=True)
+        return 0 if verdict else 1
+
+    # ---- phase 1: ramp (trickle below the band — no scale motion) -------
+    n_ramp = 8
+    for i in range(n_ramp):
+        post("ramp", i)
+        time.sleep(0.12)
+    ramp_live = live_count()
+    ramp_scale_outs = len(decisions(action=ACTION_SCALE_OUT))
+
+    # ---- phase 2: spike (flash crowd — must grow within the boot bar) ---
+    n_spike = 60
+    spike_qs = []
+    t_spike = time.perf_counter()
+    for i in range(n_spike):
+        spike_qs.append(post("spike", i))
+    total = n_ramp + n_spike
+    t_live2 = None
+    max_live = 1
+    deadline_t = time.perf_counter() + 90.0
+    while time.perf_counter() < deadline_t:
+        lv = live_count()
+        max_live = max(max_live, lv)
+        if t_live2 is None and lv >= 2:
+            t_live2 = time.perf_counter()
+        with lock:
+            done = len(terminals)
+        if done >= total and t_live2 is not None:
+            break
+        if done >= total and time.perf_counter() - t_spike > 20.0:
+            break  # drained without ever scaling: let the checks fail it
+        time.sleep(0.02)
+    with lock:
+        all_done = len(terminals) == total
+        spike_lat_ms = [(terminals[q][0] - submit_t[q]) * 1e3
+                        for q in spike_qs if q in terminals]
+        shed_kinds = sorted({k for _, k in terminals.values()
+                             if k != "result"})
+    spike_p95_ms = percentile(spike_lat_ms, 0.95)
+    time_to_scale_out_s = (round(t_live2 - t_spike, 3)
+                           if t_live2 is not None else None)
+    scale_outs = decisions(action=ACTION_SCALE_OUT)
+    first_boot_s = None
+    if scale_outs:
+        first_boot_s = (scale_outs[0].get("actuated") or {}).get("boot_s")
+
+    # ---- phase 3: trough (traffic stops — retire back down to min) ------
+    t_trough = time.perf_counter()
+    final_live = live_count()
+    while time.perf_counter() - t_trough < 30.0:
+        final_live = live_count()
+        if final_live <= 1:
+            break
+        time.sleep(0.05)
+    trough_s = round(time.perf_counter() - t_trough, 2)
+    scale_ins = decisions(action=ACTION_SCALE_IN)
+
+    hconn = http.client.HTTPConnection("127.0.0.1", app.http_port,
+                                       timeout=10)
+    hconn.request("GET", "/healthz")
+    health = json.loads(hconn.getresponse().read())
+    hconn.request("GET", "/debug/autoscale?limit=200")
+    debug = json.loads(hconn.getresponse().read())
+    hconn.close()
+    stop_consume.set()
+    reader.join(timeout=5)
+    app.stop()
+
+    last_decisions = debug.get("decisions") or []
+    record_ok = bool(last_decisions) and all(
+        k in last_decisions[-1]
+        for k in ("t", "action", "reason", "inputs", "thresholds",
+                  "cooldown"))
+    checks = {
+        "all_completed": all_done,
+        "exactly_one_terminal": not dup_terminals,
+        "no_scale_out_during_ramp": ramp_live == 1
+        and ramp_scale_outs == 0,
+        "scaled_out_under_spike": max_live >= 2 and len(scale_outs) >= 1,
+        # Capacity within one AOT-boot latency of the sustained-breach
+        # decision (actuation is inline with the decision tick, so the
+        # add_replica wall IS that latency).
+        "scale_out_within_aot_boot": first_boot_s is not None
+        and first_boot_s <= _AOT_BOOT_BAR_S,
+        "spike_to_capacity_bounded": time_to_scale_out_s is not None
+        and time_to_scale_out_s <= 10.0,
+        # Every terminal in the whole run is a result frame: nothing with
+        # deadline slack was shed while the pool was reshaping.
+        "no_sheds_during_scale_out": shed_kinds == [],
+        "scaled_in_at_trough": final_live == 1 and len(scale_ins) >= 1,
+        "healthz_reports_target_and_actual":
+            "pool_target_replicas" in health
+            and "pool_ready_replicas" in health,
+        "target_tracks_actual_at_rest":
+            health.get("pool_target_replicas")
+            == health.get("pool_ready_replicas") == 1,
+        "debug_endpoint_serves_decisions":
+            bool(debug.get("enabled")) and record_ok,
+    }
+    report = {
+        "metric": "serve_soak_autoscale",
+        "value": time_to_scale_out_s,
+        "unit": "s",
+        "jobs": total,
+        "completed": len(terminals),
+        "autoscale": {
+            "time_to_scale_out_s": time_to_scale_out_s,
+            "spike_p95_ms": (round(spike_p95_ms, 1)
+                             if spike_p95_ms is not None else None),
+        },
+        "phases": {
+            "ramp": {"jobs": n_ramp, "live_replicas": ramp_live},
+            "spike": {"jobs": n_spike, "max_live_replicas": max_live,
+                      "first_boot_s": first_boot_s,
+                      "scale_out_decisions": len(scale_outs)},
+            "trough": {"final_live_replicas": final_live,
+                       "scale_in_decisions": len(scale_ins),
+                       "settle_s": trough_s},
+        },
+        "decision_ring": len(last_decisions),
+        "aot_boot_bar_s": _AOT_BOOT_BAR_S,
+        "backend": "dryrun",
+        "checks": checks,
+    }
+    verdict = all(checks.values())
+    _ledger_autoscale(report, verdict)
+    out = args.out or "SERVE_SOAK_AUTOSCALE.json"
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report), flush=True)
+    return 0 if verdict else 1
+
+
 # Mixed burst: single-image tasks, an NLVR2 pair, and a retrieval set —
 # the ragged backlog shape run_many's chunk packing exists for.
 PATTERN = [
@@ -878,6 +1262,14 @@ def main(argv=None) -> int:
                         "tenant-weighted scheduler under a hot-key burst; "
                         "--chaos kills the coalesced leader and asserts "
                         "every follower still gets exactly one terminal")
+    p.add_argument("--autoscale", action="store_true",
+                   help="closed-loop autoscaler soak: ramp → flash-crowd "
+                        "spike → trough against dryrun replicas; asserts "
+                        "the pool grows within one AOT-boot latency of "
+                        "sustained breach, nothing sheds during "
+                        "scale-out, and capacity retires at the trough; "
+                        "--chaos runs the poison-storm variant (the "
+                        "controller must hold, never scale out)")
     p.add_argument("--kill-thread", action="store_true",
                    help="kill one scheduler intake thread mid-burst via a "
                         "one-shot queue.claim fault; asserts /healthz "
@@ -890,6 +1282,10 @@ def main(argv=None) -> int:
         "--kill-thread drains through the in-process scheduler; --chaos " \
         "drains through a remote worker — pick one"
 
+    if args.autoscale:
+        # Autoscale mode is dryrun by definition: the subject is the
+        # control loop and the pool actuators, not the forward.
+        return run_autoscale_soak(args)
     if args.zipf:
         # Duplicate-traffic mode is dryrun by definition too: hit/attach
         # semantics are host-side, the forward is a stub service time.

@@ -1,0 +1,316 @@
+"""Autoscaler decision-policy tests: pure functions, fake clock, no pool.
+
+The control step (:func:`serve.autoscale.decide`) maps (policy, state,
+inputs, now) to a decision record with no real clocks, sleeps, or
+sockets — so every policy property is testable as arithmetic: hysteresis
+band no-ops, cooldown suppression after each action, breaker-flap and
+poison-rate gating, min/max clamps, and the slow sustained-slack
+scale-in. A thin ``FakePool`` covers the :class:`Autoscaler` plumbing
+(tick → decide → actuate → decision ring) without booting anything.
+"""
+
+import dataclasses
+
+from vilbert_multitask_tpu.config import ServingConfig
+from vilbert_multitask_tpu.serve.autoscale import (
+    ACTION_HOLD,
+    ACTION_SCALE_IN,
+    ACTION_SCALE_OUT,
+    Autoscaler,
+    AutoscaleInputs,
+    AutoscalePolicy,
+    ControllerState,
+    classify,
+    decide,
+)
+
+
+def make_policy(**overrides) -> AutoscalePolicy:
+    base = dict(autoscale_enabled=True, autoscale_min_replicas=1,
+                autoscale_max_replicas=4,
+                autoscale_target_queue_wait_p95_ms=100.0,
+                autoscale_burn_threshold=1.0,
+                autoscale_band_high=1.2, autoscale_band_low=0.5,
+                autoscale_breach_ticks=3, autoscale_slack_ticks=6,
+                autoscale_cooldown_out_s=10.0, autoscale_cooldown_in_s=30.0,
+                autoscale_max_poison_rate_per_s=0.5)
+    base.update(overrides)
+    return AutoscalePolicy(ServingConfig(**base))
+
+
+BREACH = AutoscaleInputs(queue_wait_p95_ms=500.0, live_replicas=2,
+                         ready_replicas=2)
+SLACK = AutoscaleInputs(queue_wait_p95_ms=10.0, live_replicas=2,
+                        ready_replicas=2)
+
+
+def run_ticks(policy, state, inputs, n, t0=0.0, dt=1.0):
+    """n decide steps with a fake advancing clock; returns the last."""
+    d = None
+    for i in range(n):
+        d = decide(policy, state, inputs, t0 + i * dt)
+    return d
+
+
+# ------------------------------------------------------------- classify
+def test_classify_hysteresis_band():
+    p = make_policy()  # target 100, band 50..120
+    assert classify(p, AutoscaleInputs(queue_wait_p95_ms=500.0)) == "breach"
+    assert classify(p, AutoscaleInputs(queue_wait_p95_ms=121.0)) == "breach"
+    assert classify(p, AutoscaleInputs(queue_wait_p95_ms=10.0)) == "slack"
+    # Inside the dead zone: neither direction accumulates.
+    assert classify(p, AutoscaleInputs(queue_wait_p95_ms=80.0)) == "in_band"
+    assert classify(p, AutoscaleInputs(queue_wait_p95_ms=119.0)) == "in_band"
+
+
+def test_classify_empty_window_is_slack():
+    # No claims in the window (idle trough, cold start): no traffic needs
+    # no extra capacity.
+    p = make_policy()
+    assert classify(p, AutoscaleInputs(queue_wait_p95_ms=None)) == "slack"
+
+
+def test_classify_burn_needs_both_windows():
+    p = make_policy()
+    fast_only = AutoscaleInputs(queue_wait_p95_ms=80.0, burn_fast=5.0,
+                                burn_slow=0.2)
+    assert classify(p, fast_only) == "in_band"  # a blip, not a breach
+    both = AutoscaleInputs(queue_wait_p95_ms=80.0, burn_fast=5.0,
+                           burn_slow=2.0)
+    assert classify(p, both) == "breach"
+    # Burn on both windows also blocks the slack side.
+    calm_queue = AutoscaleInputs(queue_wait_p95_ms=10.0, burn_fast=5.0,
+                                 burn_slow=2.0)
+    assert classify(p, calm_queue) == "breach"
+
+
+# ------------------------------------------------------- sustain windows
+def test_hysteresis_band_never_scales():
+    p, st = make_policy(), ControllerState()
+    mid = AutoscaleInputs(queue_wait_p95_ms=80.0, live_replicas=2)
+    for i in range(50):
+        assert decide(p, st, mid, float(i))["action"] == ACTION_HOLD
+    assert st.breach_ticks == 0 and st.slack_ticks == 0
+
+
+def test_scale_out_requires_sustained_breach():
+    p, st = make_policy(autoscale_breach_ticks=3), ControllerState()
+    assert decide(p, st, BREACH, 0.0)["reason"] == "breach_building"
+    assert decide(p, st, BREACH, 1.0)["reason"] == "breach_building"
+    d = decide(p, st, BREACH, 2.0)
+    assert d["action"] == ACTION_SCALE_OUT
+    assert d["reason"] == "sustained_breach"
+    assert d["target_replicas"] == 3  # live 2 -> 3
+
+
+def test_breach_counter_resets_on_calm_tick():
+    p, st = make_policy(autoscale_breach_ticks=3), ControllerState()
+    decide(p, st, BREACH, 0.0)
+    decide(p, st, BREACH, 1.0)
+    mid = dataclasses.replace(BREACH, queue_wait_p95_ms=80.0)
+    decide(p, st, mid, 2.0)  # in-band tick breaks the streak
+    assert st.breach_ticks == 0
+    assert decide(p, st, BREACH, 3.0)["action"] == ACTION_HOLD
+
+
+def test_scale_in_requires_sustained_slack_across_slow_window():
+    p, st = make_policy(autoscale_slack_ticks=6), ControllerState()
+    for i in range(5):
+        d = decide(p, st, SLACK, float(i))
+        assert d["action"] == ACTION_HOLD
+        assert d["reason"] == "slack_building"
+    d = decide(p, st, SLACK, 5.0)
+    assert d["action"] == ACTION_SCALE_IN
+    assert d["reason"] == "sustained_slack"
+    assert d["target_replicas"] == 1
+
+
+# ------------------------------------------------------------- cooldowns
+def test_cooldown_suppresses_second_scale_out():
+    p, st = make_policy(autoscale_breach_ticks=1,
+                        autoscale_cooldown_out_s=10.0), ControllerState()
+    assert decide(p, st, BREACH, 0.0)["action"] == ACTION_SCALE_OUT
+    d = decide(p, st, BREACH, 1.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "cooldown_out"
+    assert d["cooldown"]["out_active"]
+    # The clock, not the tick count, ends the cooldown.
+    assert decide(p, st, BREACH, 10.5)["action"] == ACTION_SCALE_OUT
+
+
+def test_cooldown_suppresses_scale_in_after_scale_out():
+    # Freshly added capacity immediately makes the queue look calm; the
+    # scale-in cooldown is what stops add-retire thrash.
+    p = make_policy(autoscale_breach_ticks=1, autoscale_slack_ticks=1,
+                    autoscale_cooldown_in_s=30.0)
+    st = ControllerState()
+    assert decide(p, st, BREACH, 0.0)["action"] == ACTION_SCALE_OUT
+    d = decide(p, st, SLACK, 1.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "cooldown_in"
+    assert decide(p, st, SLACK, 31.0)["action"] == ACTION_SCALE_IN
+
+
+def test_cooldown_suppresses_after_scale_in_too():
+    p = make_policy(autoscale_slack_ticks=1, autoscale_cooldown_in_s=30.0,
+                    autoscale_min_replicas=1)
+    st = ControllerState()
+    three = dataclasses.replace(SLACK, live_replicas=3)
+    assert decide(p, st, three, 0.0)["action"] == ACTION_SCALE_IN
+    d = decide(p, st, three, 1.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "cooldown_in"
+
+
+# ---------------------------------------------------------- health gates
+def test_breaker_flap_gates_scale_out():
+    p, st = make_policy(autoscale_breach_ticks=1), ControllerState()
+    flapping = dataclasses.replace(BREACH, open_breakers=1)
+    d = decide(p, st, flapping, 0.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "breaker_open"
+    # The moment the breaker closes, the already-sustained breach fires.
+    assert decide(p, st, BREACH, 1.0)["action"] == ACTION_SCALE_OUT
+
+
+def test_poison_storm_gates_scale_out():
+    p = make_policy(autoscale_breach_ticks=1,
+                    autoscale_max_poison_rate_per_s=0.5)
+    st = ControllerState()
+    poisoned = dataclasses.replace(BREACH, poison_rate_per_s=2.0)
+    for i in range(10):
+        d = decide(p, st, poisoned, float(i))
+        assert d["action"] == ACTION_HOLD
+        assert d["reason"] == "poison_storm"
+
+
+def test_poison_storm_gates_scale_in_as_well():
+    # Retiring capacity mid-incident is no better than adding it.
+    p, st = make_policy(autoscale_slack_ticks=1), ControllerState()
+    poisoned = dataclasses.replace(SLACK, poison_rate_per_s=2.0)
+    assert decide(p, st, poisoned, 0.0)["reason"] == "poison_storm"
+
+
+# ------------------------------------------------------------ min / max
+def test_max_replicas_clamps_scale_out():
+    p, st = make_policy(autoscale_breach_ticks=1,
+                        autoscale_max_replicas=2), ControllerState()
+    at_max = dataclasses.replace(BREACH, live_replicas=2)
+    d = decide(p, st, at_max, 0.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "at_max"
+    assert d["target_replicas"] == 2
+
+
+def test_min_replicas_clamps_scale_in():
+    p, st = make_policy(autoscale_slack_ticks=1,
+                        autoscale_min_replicas=2), ControllerState()
+    at_min = dataclasses.replace(SLACK, live_replicas=2)
+    d = decide(p, st, at_min, 0.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "at_min"
+    assert d["target_replicas"] == 2
+
+
+def test_boot_in_progress_defers_second_add():
+    p, st = make_policy(autoscale_breach_ticks=1), ControllerState()
+    booting = dataclasses.replace(BREACH, booting_replicas=1)
+    d = decide(p, st, booting, 0.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "boot_in_progress"
+
+
+def test_no_engine_factory_blocks_scale_out():
+    p, st = make_policy(autoscale_breach_ticks=1), ControllerState()
+    orphan = dataclasses.replace(BREACH, can_add=False)
+    d = decide(p, st, orphan, 0.0)
+    assert d["action"] == ACTION_HOLD and d["reason"] == "no_engine_factory"
+
+
+# --------------------------------------------------- Autoscaler plumbing
+class FakePool:
+    """replicas_info/add_replica/retire_replica — all the Autoscaler
+    touches."""
+
+    def __init__(self, n=1):
+        self.infos = [{"name": f"r{i}", "state": "ready",
+                       "breaker": "closed"} for i in range(n)]
+        self.added = 0
+        self.retired = 0
+
+    def replicas_info(self):
+        return [dict(r) for r in self.infos]
+
+    def add_replica(self, engine, warm=True):
+        self.added += 1
+        info = {"name": f"r{len(self.infos)}", "state": "ready",
+                "breaker": "closed"}
+        self.infos.append(info)
+        return type("R", (), {"name": info["name"], "state": "ready"})()
+
+    def retire_replica(self, name=None):
+        self.retired += 1
+        info = self.infos.pop()
+        return {"name": info["name"], "drain_s": 0.0}
+
+
+def make_autoscaler(pool, clock, **overrides):
+    base = dict(autoscale_enabled=True, autoscale_breach_ticks=2,
+                autoscale_slack_ticks=3, autoscale_cooldown_out_s=5.0,
+                autoscale_cooldown_in_s=5.0, autoscale_max_replicas=3,
+                autoscale_target_queue_wait_p95_ms=100.0)
+    base.update(overrides)
+    return Autoscaler(pool, ServingConfig(**base),
+                      engine_factory=lambda: object(), clock=clock)
+
+
+def test_tick_scales_out_then_in_with_fake_clock():
+    pool = FakePool(1)
+    t = [0.0]
+    a = make_autoscaler(pool, lambda: t[0])
+    # Force the sensor sweep: breach inputs while the clock advances.
+    breach = AutoscaleInputs(queue_wait_p95_ms=900.0, live_replicas=1,
+                             ready_replicas=1)
+    a.observe = lambda now=None: dataclasses.replace(
+        breach, live_replicas=len(pool.infos),
+        ready_replicas=len(pool.infos))
+    for _ in range(2):
+        t[0] += 1.0
+        a.tick()
+    assert pool.added == 1
+    assert a.target_replicas == 2
+    # Now sustained slack past the cooldown: the pool shrinks back.
+    slack = AutoscaleInputs(queue_wait_p95_ms=1.0)
+    a.observe = lambda now=None: dataclasses.replace(
+        slack, live_replicas=len(pool.infos),
+        ready_replicas=len(pool.infos))
+    t[0] += 10.0  # clear the cooldown
+    for _ in range(3):
+        t[0] += 1.0
+        a.tick()
+    assert pool.retired == 1
+    assert a.target_replicas == 1
+
+
+def test_decision_ring_is_bounded():
+    pool = FakePool(1)
+    t = [0.0]
+    a = make_autoscaler(pool, lambda: t[0],
+                        autoscale_decision_history=8)
+    a.observe = lambda now=None: AutoscaleInputs(queue_wait_p95_ms=80.0)
+    for _ in range(50):
+        t[0] += 1.0
+        a.tick()
+    assert len(a.decisions) == 8  # deque(maxlen=...) — the VMT115 bound
+
+
+def test_debug_payload_shape():
+    pool = FakePool(1)
+    t = [0.0]
+    a = make_autoscaler(pool, lambda: t[0])
+    a.observe = lambda now=None: AutoscaleInputs(queue_wait_p95_ms=80.0)
+    t[0] = 1.0
+    a.tick()
+    body = a.debug_payload(limit=10)
+    assert body["enabled"] is True
+    assert body["target_replicas"] == 1
+    assert body["policy"]["max_replicas"] == 3
+    rec = body["decisions"][-1]
+    # The debug contract: inputs observed, thresholds, action, cooldown.
+    assert rec["action"] == ACTION_HOLD
+    assert rec["inputs"]["queue_wait_p95_ms"] == 80.0
+    assert rec["thresholds"]["breach_above_ms"] == 120.0
+    assert "out_active" in rec["cooldown"]

@@ -459,3 +459,82 @@ def test_crash_recovery_via_visibility_timeout(tiny_framework_cfg, engine,
     assert len(results) == 2  # one terminal per job, despite redelivery
     questions = {f["result"]["question"] for f in results}
     assert questions == {"q0", "q1"}
+
+
+# ------------------------------------------------- retire (scale-in path)
+def test_retire_unnamed_picks_least_loaded_ready():
+    pool = make_pool(3)
+    # r1 is busiest, r2 has history; r0 is the cheapest to drain.
+    pool.replicas[1].inflight = 2
+    pool.replicas[2].dispatches = 5
+    info = pool.retire_replica()
+    assert info["name"] == "r0"
+    assert [r.name for r in pool.replicas] == ["r1", "r2"]
+
+
+def test_retire_withdraws_state_gauge_and_healthz_block():
+    from vilbert_multitask_tpu import obs
+
+    pool = make_pool(2)
+    pool.probe()  # publish both series
+    assert obs.REPLICA_STATE.value(replica="r1") is not None
+    pool.retire_replica("r1")
+    # No ghost replica: the gauge series is gone and stays gone through
+    # the next probe sweep (which only walks surviving replicas).
+    assert obs.REPLICA_STATE.value(replica="r1") is None
+    pool.probe()
+    assert obs.REPLICA_STATE.value(replica="r1") is None
+    assert [r["name"] for r in pool.replicas_info()] == ["r0"]
+
+
+def test_retire_refuses_below_min_replicas():
+    pool = make_pool(2, autoscale_min_replicas=2)
+    with pytest.raises(ValueError, match="autoscale_min_replicas"):
+        pool.retire_replica()
+    assert len(pool.replicas) == 2
+
+
+def test_retire_refuses_last_ready_replica():
+    pool = make_pool(2)
+    pool.replicas[1].state = STATE_DEGRADED
+    with pytest.raises(ValueError, match="last READY"):
+        pool.retire_replica("r0")
+    assert len(pool.replicas) == 2
+
+
+def test_retire_waits_for_inflight_drain():
+    pool = make_pool(2, pool_checkout_timeout_s=1.0)
+    rep = pool.checkout()  # one dispatch in flight on some replica
+    victim = rep.name
+    done = []
+
+    def finish():
+        time.sleep(0.1)
+        pool.checkin(rep, ok=True)
+        done.append(True)
+
+    threading.Thread(target=finish, daemon=True).start()
+    info = pool.retire_replica(victim, drain_timeout_s=5.0)
+    assert done  # the retire blocked until the in-flight call finished
+    assert info["name"] == victim
+    assert victim not in {r.name for r in pool.replicas}
+
+
+def test_retire_drain_timeout_restores_replica():
+    pool = make_pool(2)
+    rep = pool.replicas[0]
+    rep.inflight = 1  # a dispatch that never finishes
+    with pytest.raises(TimeoutError):
+        pool.retire_replica("r0", drain_timeout_s=0.1)
+    # Abandoned retirement, not a stranded replica: back in rotation.
+    assert rep.state == STATE_READY
+    assert len(pool.replicas) == 2
+
+
+def test_add_then_retire_roundtrip_keeps_pool_consistent():
+    pool = make_pool(1)
+    pool.add_replica(FakeEngine(), warm=True)
+    assert pool.ready_count() == 2
+    info = pool.retire_replica()
+    assert pool.ready_count() == 1
+    assert info["name"] not in {r.name for r in pool.replicas}

@@ -73,7 +73,7 @@ def test_debug_surface_is_wired():
         REPO_ROOT, "vilbert_multitask_tpu", "serve", "http_api.py")).read()
     for route in ("/healthz", "/metrics", "/debug/slo", "/debug/timeseries",
                   "/debug/trace", "/debug/costs", "/debug/traces",
-                  "/debug/autopsy"):
+                  "/debug/autopsy", "/debug/autoscale"):
         assert f'"{route}"' in api_src, f"route {route} left the http api"
 
 

@@ -586,6 +586,29 @@ class ServingConfig:
     tenant_fairness_enabled: bool = True
     tenant_weights: Mapping[str, float] | None = None
     tenant_default_weight: float = 1.0
+    # --- closed-loop autoscaler (serve/autoscale.py; ROADMAP item 1) ---
+    # Target-tracking on queue-wait p95 and SLO burn rate, riding the obs
+    # sampler cadence. Breach above target*band_high for breach_ticks
+    # consecutive ticks scales OUT (pool.add_replica); slack below
+    # target*band_low AND burn below threshold for slack_ticks ticks
+    # scales IN (pool.retire_replica, never below min). Scale-out is
+    # additionally gated on pool health: any open replica breaker or a
+    # poison/dead-letter rate above max_poison_rate_per_s reads as
+    # "unhealthy, don't scale", not "overloaded, add replicas".
+    autoscale_enabled: bool = False
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    autoscale_target_queue_wait_p95_ms: float = 500.0
+    autoscale_burn_threshold: float = 1.0
+    autoscale_band_high: float = 1.2
+    autoscale_band_low: float = 0.5
+    autoscale_breach_ticks: int = 3
+    autoscale_slack_ticks: int = 12
+    autoscale_cooldown_out_s: float = 30.0
+    autoscale_cooldown_in_s: float = 60.0
+    autoscale_max_poison_rate_per_s: float = 0.5
+    autoscale_window_s: float = 30.0
+    autoscale_decision_history: int = 128
 
 
 @dataclasses.dataclass(frozen=True)

@@ -119,6 +119,17 @@ class Gauge(_Instrument):
         with self._lock:
             return self._values.get(self._key(labels))
 
+    def remove(self, **labels) -> bool:
+        """Withdraw one label set's series entirely.
+
+        A gauge is point-in-time state, not history: when the thing it
+        describes stops existing (a retired replica), its series must
+        leave exposition too, or fleet views show ghosts at the last
+        value forever. Returns True when a series was actually dropped.
+        """
+        with self._lock:
+            return self._values.pop(self._key(labels), None) is not None
+
     def collect(self) -> Dict[Tuple[str, ...], float]:
         with self._lock:
             return dict(self._values)

@@ -14,12 +14,13 @@ import dataclasses
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from vilbert_multitask_tpu import obs
 from vilbert_multitask_tpu.config import FrameworkConfig, config_fingerprint
 from vilbert_multitask_tpu.engine.runtime import InferenceEngine
 from vilbert_multitask_tpu.features.store import FeatureStore
+from vilbert_multitask_tpu.serve.autoscale import Autoscaler
 from vilbert_multitask_tpu.serve.db import ResultStore
 from vilbert_multitask_tpu.serve.http_api import ApiServer
 from vilbert_multitask_tpu.serve.pool import ReplicaPool
@@ -34,6 +35,9 @@ _FLEET_FLUSH_ERRORS = obs.REGISTRY.counter(
 _TRACESTORE_FLUSH_ERRORS = obs.REGISTRY.counter(
     "vmt_tracestore_flush_errors_total",
     "Sampler ticks whose trace-store flush failed (local tick unaffected).")
+_AUTOSCALE_TICK_ERRORS = obs.REGISTRY.counter(
+    "vmt_autoscale_tick_errors_total",
+    "Sampler ticks whose autoscale control step raised (tick unaffected).")
 
 
 class ServeApp:
@@ -42,7 +46,8 @@ class ServeApp:
                  feature_root: str = "features",
                  checkpoint_path: Optional[str] = None,
                  live_extract: bool = False,
-                 detector_checkpoint: Optional[str] = None):
+                 detector_checkpoint: Optional[str] = None,
+                 engine_factory: Optional[Callable[[], Any]] = None):
         self.cfg = cfg or FrameworkConfig()
         s = self.cfg.serving
         # Persistent XLA compile cache on by default for the serving binary:
@@ -176,6 +181,16 @@ class ServeApp:
                     engines[0].book_boot_time("restore_s", restore.seconds)
             self.boot_info["engine_init_s"] = round(
                 time.perf_counter() - t0, 1)
+            if engine_factory is None:
+                # Scale-out builds engines exactly like the boot replicas:
+                # shared param tree, mesh, feature store, and AOT cache —
+                # a new replica warm-boots from the same executables in
+                # seconds instead of recompiling for minutes.
+                def engine_factory(_params=params, _mesh=mesh,
+                                   _store=store, _aot=aot):
+                    return InferenceEngine(self.cfg, params=_params,
+                                           mesh=_mesh, feature_store=_store,
+                                           aot_cache=_aot)
         # The serving plane always programs against a ReplicaPool — with
         # one replica it degenerates to a thin facade over the engine; the
         # checkout/checkin seam, health states, and failover semantics stay
@@ -220,6 +235,15 @@ class ServeApp:
         self.slos = self._build_slos()
         self.sampler = obs.Sampler(self.timeseries, self._sample,
                                    cadence_s=s.sampler_cadence_s)
+        # Closed-loop autoscaler (serve/autoscale.py): its control step
+        # rides _sample() — the same no-new-threads deal as pool.probe().
+        # Off by default; the knob block in ServingConfig documents the
+        # policy.
+        self.autoscaler: Optional[Autoscaler] = None
+        if s.autoscale_enabled:
+            self.autoscaler = Autoscaler(
+                self.engine, s, slos=self.slos, queue=self.queue,
+                engine_factory=engine_factory)
         # Fleet observability: this process's identity plus its handle on
         # the shared metrics spine (a WAL sqlite next to the queue db).
         # Every sampler tick flushes instruments/timeseries/spans/heartbeat
@@ -273,7 +297,7 @@ class ServeApp:
             slos=self.slos, timeseries=self.timeseries,
             pool=self.engine, swap_fn=self.rolling_swap, fleet=self.fleet,
             attrib=self.attrib, tracestore=self.tracestore,
-            cache=self.cache)
+            cache=self.cache, autoscaler=self.autoscaler)
         self.ws = WebSocketBridge(self.hub, s.http_host, s.ws_port)
         self.http_port: Optional[int] = None  # actual bound port after start
         self._stop = threading.Event()
@@ -399,6 +423,15 @@ class ServeApp:
         worst = self.slos.worst_state()
         vals["slo_worst"] = float(
             {"ok": 0, "warn": 1, "page": 2}.get(worst, 0))
+        # Autoscaler control step: sensors read the instruments the lines
+        # above just refreshed (live_stats ran pool.probe), actions land
+        # on the pool inline — no thread of its own. Isolated failure
+        # domain: a raising actuator must not cost the tick.
+        if self.autoscaler is not None:
+            try:
+                vals.update(self.autoscaler.tick())
+            except Exception:  # noqa: BLE001
+                _AUTOSCALE_TICK_ERRORS.inc()
         # Publish this tick to the fleet spine (heartbeat + instrument
         # snapshots + timeseries deltas + fresh spans). Isolated failure
         # domain: a locked/corrupt spine db must not cost the LOCAL tick.

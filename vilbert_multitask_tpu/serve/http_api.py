@@ -58,6 +58,7 @@ class ApiServer:
         attrib=None,
         tracestore=None,
         cache: Optional[ResultCache] = None,
+        autoscaler=None,
     ):
         self.queue = queue
         self.store = store
@@ -99,6 +100,10 @@ class ApiServer:
         # publish: hits answer straight from sqlite (no queue, no TPU),
         # identical in-flight submits coalesce onto one leader job.
         self.cache = cache
+        # Closed-loop autoscaler (serve/autoscale.py, ServeApp wires it):
+        # /debug/autoscale serves the last-N decision records, /healthz
+        # pairs its target replica count with the pool's actual.
+        self.autoscaler = autoscaler
         # Actual websocket port for the browser client; ServeApp overwrites
         # this after the bridge binds (ws_port=0 picks a free port in tests).
         self.ws_port: int = self.serving.ws_port
@@ -323,6 +328,15 @@ class ApiServer:
         if self.pool is not None:
             body["replicas"] = self.pool.replicas_info()
             body["ready_replicas"] = self.pool.ready_count()
+            # Target vs actual: an external probe seeing ready < target
+            # reads "scale event in progress", not "degraded pool". With
+            # no autoscaler the target IS the live replica count.
+            body["pool_ready_replicas"] = self.pool.ready_count()
+            body["pool_target_replicas"] = (
+                self.autoscaler.target_replicas
+                if self.autoscaler is not None else
+                sum(1 for r in self.pool.replicas_info()
+                    if r["state"] != "dead"))
         if not ready:
             body["reason"] = (
                 "booting" if booting
@@ -375,6 +389,15 @@ class ApiServer:
         if self.tracestore is not None:
             body["tracestore"] = self.tracestore.stats()
         return 200, body
+
+    def debug_autoscale(self, limit: int) -> Tuple[int, Dict[str, Any]]:
+        """``GET /debug/autoscale?limit=``: the controller's policy knobs,
+        live sustain/cooldown state, target-vs-actual replica counts, and
+        the last-N decision records (inputs observed, thresholds, action,
+        cooldown state) — the ring the autoscaler keeps bounded."""
+        if self.autoscaler is None:
+            return 200, {"enabled": False, "decisions": []}
+        return 200, self.autoscaler.debug_payload(limit=limit)
 
     def debug_traces(self, *, verdict: Optional[str], task: Optional[str],
                      tenant: Optional[str], scope: str,
@@ -708,6 +731,17 @@ class ApiServer:
 
                     q = parse_qs(urlsplit(self.path).query)
                     self._json(*api.autopsy(q.get("trace_id", [""])[0]))
+                elif (path == "/debug/autoscale"
+                      or path.startswith("/debug/autoscale?")):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        limit = int(q.get("limit", ["50"])[0])
+                    except ValueError:
+                        limit = 50
+                    self._json(*api.debug_autoscale(
+                        limit=max(1, min(limit, 500))))
                 else:
                     self._json(404, {"error": "not found"})
 

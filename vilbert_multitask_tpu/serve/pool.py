@@ -546,6 +546,85 @@ class ReplicaPool:
         return report
 
     # ------------------------------------------------------------------
+    # Scale-in: drain-then-remove (the autoscaler's shrink actuator).
+
+    def retire_replica(self, name: Optional[str] = None,
+                       drain_timeout_s: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """Drain one replica and REMOVE it from the pool — the inverse of
+        :meth:`add_replica`.
+
+        Reuses the rolling-swap DRAINING machinery: the victim stops
+        admitting work, the in-flight dispatches finish, then the replica
+        leaves ``self.replicas`` and its ``vmt_replica_state`` series is
+        withdrawn — a retired replica must not haunt /healthz or fleet
+        views as a ghost. Unnamed, the least-loaded READY replica is
+        picked (same ordering as checkout, inverted). Refuses to shrink
+        the live pool below ``autoscale_min_replicas`` or to retire the
+        last READY replica; a drain timeout puts the victim back into
+        rotation rather than stranding it DRAINING.
+        """
+        if drain_timeout_s is None:
+            drain_timeout_s = self._serving.pool_swap_drain_timeout_s
+        min_live = max(1, int(self._serving.autoscale_min_replicas))
+        # Serialize against rolling swaps: both walk replicas through
+        # DRAINING, and a swap iterating a list the retire just mutated
+        # is the kind of race this lock exists for.
+        if not self._swap_lock.acquire(timeout=drain_timeout_s):
+            raise TimeoutError(
+                f"retire stalled: a rolling swap held the pool for "
+                f"{drain_timeout_s:.1f}s")
+        t0 = time.perf_counter()
+        try:
+            with self._cond:
+                if name is None:
+                    ready = [r for r in self.replicas
+                             if r.state == STATE_READY]
+                    if not ready:
+                        raise ValueError("no READY replica to retire")
+                    rep = min(ready,
+                              key=lambda r: (r.inflight, r.dispatches))
+                else:
+                    rep = self._by_name(name)
+                live = sum(1 for r in self.replicas
+                           if r.state != STATE_DEAD)
+                if rep.state != STATE_DEAD and live <= min_live:
+                    raise ValueError(
+                        f"refusing to retire {rep.name}: {live} live "
+                        f"replica(s) <= autoscale_min_replicas="
+                        f"{min_live}")
+                if rep.state == STATE_READY and not any(
+                        r.state == STATE_READY for r in self.replicas
+                        if r is not rep):
+                    raise ValueError(
+                        f"refusing to retire {rep.name}: it is the "
+                        f"last READY replica")
+                self._set_state_locked(rep, STATE_DRAINING)
+                self._cond.notify_all()
+                try:
+                    self._wait_locked(
+                        lambda: rep.inflight == 0, drain_timeout_s,
+                        f"{rep.name} did not drain for retirement")
+                except TimeoutError:
+                    # Abandon the retirement, not the replica: back into
+                    # rotation rather than stuck DRAINING forever.
+                    self._set_state_locked(rep, STATE_READY)
+                    self._cond.notify_all()
+                    raise
+                self.replicas.remove(rep)
+                self._cond.notify_all()
+        finally:
+            self._swap_lock.release()
+        # Withdraw the state series AFTER removal — probe() iterates
+        # self.replicas, so it can no longer re-publish the ghost.
+        obs.REPLICA_STATE.remove(replica=rep.name)
+        drain_s = round(time.perf_counter() - t0, 3)
+        obs.record_event("replica_retired", replica=rep.name,
+                         drain_s=drain_s, dispatches=rep.dispatches)
+        return {"name": rep.name, "drain_s": drain_s,
+                "dispatches": rep.dispatches, "state": rep.state}
+
+    # ------------------------------------------------------------------
     # Introspection (for /healthz, the sampler, and tests).
 
     def ready_count(self) -> int:

@@ -219,7 +219,13 @@ class DurableQueue:
                 "dead_notified=? WHERE id=?",
                 (status, 1 if status == "dead" else 0, job_id),
             )
-            return status
+        if status == "dead":
+            # A poison job is poison however it dead-letters: the explicit
+            # nack path must feed vmt_poison_jobs_total the same as the
+            # claim-side sweep — the autoscaler's storm gate reads the
+            # counter's windowed rate and must see BOTH paths.
+            obs.POISON_COUNTER.inc()
+        return status
 
     def release(self, job_id: int) -> None:
         """Un-claim without charging a delivery attempt, for consumers that
